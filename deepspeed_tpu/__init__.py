@@ -1,0 +1,28 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capability surface of DeepSpeed (reference: weilianglin101/DeepSpeed).
+
+Front door parity: deepspeed/__init__.py — ``initialize``,
+``init_distributed``, ``init_inference``, ``DeepSpeedConfig``.
+The compute path is JAX/XLA/Pallas over a device mesh; ZeRO, pipeline,
+tensor/sequence/expert parallelism are expressed as shardings + shard_map
+schedules instead of NCCL process groups.
+"""
+
+from .version import __version__  # noqa: F401
+from .config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from .comm import init_distributed  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Parity: deepspeed.initialize(model=..., config=...) →
+    (engine, optimizer, dataloader, lr_scheduler)."""
+    from .runtime.engine import initialize as _initialize
+
+    return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Parity: deepspeed.init_inference."""
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(*args, **kwargs)

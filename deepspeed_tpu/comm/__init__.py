@@ -1,0 +1,121 @@
+"""deepspeed_tpu.comm — the XLA-collective communication backend.
+
+Parity: deepspeed/comm/__init__.py + deepspeed/comm/comm.py. The reference
+maintains NCCL/CCL process groups and exposes torch.distributed-style ops;
+here the "backend" is the XLA runtime itself: ``init_distributed`` wires up
+multi-host JAX (the NCCL-bootstrap equivalent), builds the global
+:class:`MeshTopology`, and the op surface in :mod:`collectives` runs inside
+``shard_map`` where XLA lowers psum/all_gather/reduce_scatter/ppermute/
+all_to_all onto ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..utils.logging import log_dist, logger
+from . import collectives
+from .collectives import (  # noqa: F401  (re-export op surface)
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    barrier,
+    broadcast,
+    permute,
+    reduce_scatter,
+    register_comm_hook,
+    send_backward,
+    send_forward,
+)
+from .topology import (  # noqa: F401
+    AXIS_ORDER,
+    MeshTopology,
+    ParallelDims,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+)
+
+_TOPOLOGY: Optional[MeshTopology] = None
+_INITIALIZED = False
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init_distributed(
+    dist_backend: str = "xla",
+    topology: Optional[MeshTopology] = None,
+    dims: Optional[ParallelDims] = None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **_ignored,
+) -> MeshTopology:
+    """Parity: deepspeed.init_distributed().
+
+    Multi-host: if coordinator env/args are present, calls
+    ``jax.distributed.initialize`` (the reference's torch.distributed init).
+    Then builds the global mesh topology over all visible devices.
+    """
+    global _TOPOLOGY, _INITIALIZED
+    if dist_backend not in ("xla", "tpu", "auto"):
+        logger.warning(f"dist_backend={dist_backend!r} ignored; TPU build always uses XLA")
+    coord = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
+    if coord and jax.process_count() == 1 and not _INITIALIZED:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=num_processes or int(os.environ.get("DSTPU_NUM_PROCESSES", "1")),
+            process_id=process_id or int(os.environ.get("DSTPU_PROCESS_ID", "0")),
+        )
+    if topology is not None:
+        _TOPOLOGY = topology
+    elif dims is not None or _TOPOLOGY is None:
+        _TOPOLOGY = MeshTopology(dims or ParallelDims())
+    _INITIALIZED = True
+    log_dist(f"init_distributed: {_TOPOLOGY}")
+    return _TOPOLOGY
+
+
+def set_topology(topology: MeshTopology) -> None:
+    global _TOPOLOGY, _INITIALIZED
+    _TOPOLOGY = topology
+    _INITIALIZED = True
+
+
+def get_topology() -> MeshTopology:
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        init_distributed()
+    return _TOPOLOGY
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+def get_world_size(group: Optional[str] = None) -> int:
+    """Parity: deepspeed.comm.get_world_size. ``group`` is a mesh axis name."""
+    topo = get_topology()
+    if group is None:
+        return topo.world_size
+    return topo.get_dim(group)
+
+
+def get_rank() -> int:
+    """Global device-0 rank of this *process* (SPMD: one program, many chips)."""
+    return jax.process_index()
+
+
+def get_local_rank() -> int:
+    return 0
+
+
+def destroy_process_group() -> None:
+    global _TOPOLOGY, _INITIALIZED
+    _TOPOLOGY = None
+    _INITIALIZED = False

@@ -1,0 +1,165 @@
+"""Device-mesh topology.
+
+Parity: deepspeed/runtime/pipe/topology.py (ProcessTopology,
+PipeModelDataParallelTopology, PipelineParallelGrid) — except rebuilt around
+``jax.sharding.Mesh``. Where the reference enumerates process ranks into
+NCCL groups, a TPU mesh *is* the group structure: each named axis is a
+communicator, and XLA routes its collectives over ICI along that axis.
+
+Axis order fixes ICI locality: later axes are laid out over adjacent devices,
+so the most bandwidth-hungry axis (tp) is innermost and dp — which may ride
+DCN in multi-pod jobs — is outermost.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order, outermost → innermost.
+AXIS_ORDER: Tuple[str, ...] = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+# DeepSpeed name → ours (reference topology axes are pipe/data/model).
+AXIS_ALIASES = {"data": "dp", "pipe": "pp", "model": "tp", "expert": "ep", "sequence": "sp"}
+
+
+def _canon(axis: str) -> str:
+    return AXIS_ALIASES.get(axis, axis)
+
+
+@dataclass(frozen=True)
+class ParallelDims:
+    """Requested parallel degrees; dp is inferred when left at 0."""
+
+    dp: int = 0
+    fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, world_size: int) -> Dict[str, int]:
+        sizes = {"fsdp": self.fsdp, "pp": self.pp, "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        known = int(np.prod(list(sizes.values())))
+        if self.dp:
+            sizes["dp"] = self.dp
+            if self.dp * known != world_size:
+                raise ValueError(
+                    f"parallel dims {sizes} do not multiply to world size {world_size}"
+                )
+        else:
+            if world_size % known != 0:
+                raise ValueError(
+                    f"world size {world_size} not divisible by non-dp dims product {known}"
+                )
+            sizes["dp"] = world_size // known
+        return {ax: sizes[ax] for ax in AXIS_ORDER}
+
+
+class MeshTopology:
+    """An N-d named device mesh with DeepSpeed-style rank/coord queries."""
+
+    def __init__(
+        self,
+        dims: Optional[ParallelDims] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        **axis_sizes: int,
+    ):
+        if dims is None:
+            dims = ParallelDims(**{_canon(k): v for k, v in axis_sizes.items()})
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.world_size = len(self.devices)
+        self.sizes = dims.resolve(self.world_size)
+        self.axes: Tuple[str, ...] = tuple(ax for ax in AXIS_ORDER)
+        grid = np.asarray(self.devices, dtype=object).reshape(
+            [self.sizes[ax] for ax in self.axes]
+        )
+        self.mesh = Mesh(grid, self.axes)
+
+    # -- DeepSpeed ProcessTopology parity -------------------------------------
+    def get_dim(self, axis: str) -> int:
+        return self.sizes[_canon(axis)]
+
+    @property
+    def dp_size(self) -> int:
+        return self.sizes["dp"]
+
+    @property
+    def fsdp_size(self) -> int:
+        return self.sizes["fsdp"]
+
+    @property
+    def pp_size(self) -> int:
+        return self.sizes["pp"]
+
+    @property
+    def tp_size(self) -> int:
+        return self.sizes["tp"]
+
+    @property
+    def sp_size(self) -> int:
+        return self.sizes["sp"]
+
+    @property
+    def ep_size(self) -> int:
+        return self.sizes["ep"]
+
+    @property
+    def data_shard_size(self) -> int:
+        """Total ways the global batch is split (dp × fsdp share the batch)."""
+        return self.sizes["dp"] * self.sizes["fsdp"]
+
+    def get_coord(self, rank: int) -> Dict[str, int]:
+        shape = [self.sizes[ax] for ax in self.axes]
+        coords = np.unravel_index(rank, shape)
+        return {ax: int(c) for ax, c in zip(self.axes, coords)}
+
+    def get_rank(self, **coords: int) -> int:
+        coords = {_canon(k): v for k, v in coords.items()}
+        full = [coords.get(ax, 0) for ax in self.axes]
+        shape = [self.sizes[ax] for ax in self.axes]
+        return int(np.ravel_multi_index(full, shape))
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Ranks grouped into communicators along ``axis`` (reference parity)."""
+        axis = _canon(axis)
+        others = [ax for ax in self.axes if ax != axis]
+        lists = []
+        ranges = [range(self.sizes[ax]) for ax in others]
+        for combo in itertools.product(*ranges):
+            fixed = dict(zip(others, combo))
+            lists.append([self.get_rank(**{**fixed, axis: i}) for i in range(self.sizes[axis])])
+        return lists
+
+    # -- sharding helpers -----------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def batch_spec(self) -> PartitionSpec:
+        """Global-batch partitioning: batch over (dp, fsdp), seq over sp."""
+        axes: Tuple = tuple(a for a in ("dp", "fsdp") if self.sizes[a] > 1)
+        batch_axes = axes if axes else None
+        seq_axes = "sp" if self.sizes["sp"] > 1 else None
+        return PartitionSpec(batch_axes, seq_axes)
+
+    def __repr__(self) -> str:
+        dims = "x".join(f"{ax}={self.sizes[ax]}" for ax in self.axes if self.sizes[ax] > 1)
+        return f"MeshTopology({dims or 'single-device'}, world={self.world_size})"
+
+
+# Reference-compatible constructor names ---------------------------------------
+def PipeModelDataParallelTopology(num_pp: int, num_mp: int, num_dp: int, **kw) -> MeshTopology:
+    """Parity: deepspeed.runtime.pipe.topology.PipeModelDataParallelTopology."""
+    return MeshTopology(ParallelDims(dp=num_dp, pp=num_pp, tp=num_mp), **kw)
+
+
+def PipeDataParallelTopology(num_pp: int, num_dp: int, **kw) -> MeshTopology:
+    return MeshTopology(ParallelDims(dp=num_dp, pp=num_pp), **kw)

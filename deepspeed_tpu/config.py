@@ -1,0 +1,476 @@
+"""DeepSpeed-compatible configuration.
+
+Parity: deepspeed/runtime/config.py (DeepSpeedConfig) and the per-section
+config dataclasses under deepspeed/runtime/*/config.py. Accepts the same
+``ds_config.json`` schema (a dict or a path), validates the batch-size
+triangle, and exposes typed sections.
+
+TPU-first notes: ``train_micro_batch_size_per_gpu`` keeps its reference name
+but means per-*dp-shard* micro batch; ``"auto"`` values are resolved at
+``initialize()`` time like the HF integration does in the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+AUTO = "auto"
+
+
+class DeepSpeedConfigError(ValueError):
+    pass
+
+
+def _get(d: Dict[str, Any], key: str, default=None):
+    v = d.get(key, default)
+    return default if v == AUTO else v
+
+
+@dataclass
+class OptimizerConfig:
+    """Parity: "optimizer" section (deepspeed/runtime/config.py)."""
+
+    type: str = "adamw"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def lr(self) -> float:
+        return float(self.params.get("lr", 1e-3))
+
+    @property
+    def betas(self) -> Tuple[float, float]:
+        betas = self.params.get("betas", (0.9, 0.999))
+        return (float(betas[0]), float(betas[1]))
+
+    @property
+    def eps(self) -> float:
+        return float(self.params.get("eps", 1e-8))
+
+    @property
+    def weight_decay(self) -> float:
+        return float(self.params.get("weight_decay", 0.0))
+
+
+@dataclass
+class SchedulerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class FP16Config:
+    """Parity: "fp16" section incl. dynamic loss scaling knobs."""
+
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == 0.0
+
+    @property
+    def initial_scale(self) -> float:
+        if not self.dynamic:
+            return float(self.loss_scale)
+        return float(2.0 ** self.initial_scale_power)
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = False
+    # reference: bf16 grad accumulation dtype option (accumulate_grads_in_fp32)
+    accumulate_grads_in_fp32: bool = True
+
+
+@dataclass
+class OffloadConfig:
+    """Parity: "offload_optimizer"/"offload_param" subsections."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    pin_memory: bool = True
+    buffer_count: int = 4
+    buffer_size: int = 100 * 2**20
+    max_in_cpu: int = 10**9
+
+    @property
+    def enabled(self) -> bool:
+        return self.device not in ("none", None)
+
+
+@dataclass
+class ZeroConfig:
+    """Parity: deepspeed/runtime/zero/config.py (DeepSpeedZeroConfig)."""
+
+    stage: int = 0
+    allgather_partitions: bool = True
+    overlap_comm: bool = True
+    reduce_scatter: bool = True
+    contiguous_gradients: bool = True
+    reduce_bucket_size: int = 5 * 10**8
+    allgather_bucket_size: int = 5 * 10**8
+    sub_group_size: int = 10**9
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    stage3_max_live_parameters: int = 10**9
+    stage3_max_reuse_distance: int = 10**9
+    stage3_prefetch_bucket_size: int = 5 * 10**7
+    stage3_param_persistence_threshold: int = 10**5
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    # ZeRO++ knobs (reference: zero_quantized_* / zero_hpz_partition_size)
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    # MiCS-style sub-partitioning
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+
+    def validate(self) -> None:
+        if self.stage not in (0, 1, 2, 3):
+            raise DeepSpeedConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        for off in (self.offload_optimizer, self.offload_param):
+            if off.device not in ("none", "cpu", "nvme", None):
+                raise DeepSpeedConfigError(f"offload device must be none|cpu|nvme, got {off.device}")
+            if off.device == "nvme" and not off.nvme_path:
+                raise DeepSpeedConfigError("nvme offload requires nvme_path")
+        if self.offload_param.enabled and self.stage != 3:
+            raise DeepSpeedConfigError("offload_param requires ZeRO stage 3")
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """Parity: "activation_checkpointing" section; `policy` is TPU-native
+    (maps to jax.checkpoint policies) replacing partition_activations et al."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    policy: str = "none"  # none | full | dots_saveable | attn_only | offload_host
+
+
+@dataclass
+class PipelineConfig:
+    """Parity: PipelineEngine config (runtime/pipe/engine.py kwargs)."""
+
+    stages: int = 1
+    partition_method: str = "parameters"  # parameters | uniform | type:<regex>
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_schedule: str = "1f1b"  # 1f1b | gpipe (memory policy; grads identical)
+
+
+@dataclass
+class MoEConfig:
+    enabled: bool = False
+    ep_size: int = 1
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    aux_loss_coef: float = 0.01
+    z_loss_coef: float = 1e-3
+    drop_tokens: bool = True
+    use_residual: bool = False
+
+
+@dataclass
+class TensorParallelConfig:
+    """Parity: autotp / "tensor_parallel" section."""
+
+    tp_size: int = 1
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class CommsLoggerConfig:
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@dataclass
+class MonitorConfig:
+    tensorboard: Dict[str, Any] = field(default_factory=dict)
+    wandb: Dict[str, Any] = field(default_factory=dict)
+    csv_monitor: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def enabled(self) -> bool:
+        return any(
+            bool(sec.get("enabled", False))
+            for sec in (self.tensorboard, self.wandb, self.csv_monitor)
+        )
+
+
+@dataclass
+class CurriculumConfig:
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RandomLTDConfig:
+    enabled: bool = False
+    total_layer_num: int = 0
+    random_ltd_layer_num: int = 0
+    random_ltd_layer_id: List[int] = field(default_factory=list)
+    model_mask_name: Optional[str] = None
+    model_type: str = "decoder"
+    hidden_state_order: str = "batch_seq_dim"
+    random_ltd_schedule: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DataEfficiencyConfig:
+    enabled: bool = False
+    seed: int = 1234
+    curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
+    random_ltd: RandomLTDConfig = field(default_factory=RandomLTDConfig)
+
+
+@dataclass
+class CompressionConfig:
+    weight_quantization: Dict[str, Any] = field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = field(default_factory=dict)
+    head_pruning: Dict[str, Any] = field(default_factory=dict)
+    row_pruning: Dict[str, Any] = field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class AutotuningConfig:
+    enabled: bool = False
+    fast: bool = True
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    max_train_micro_batch_size_per_gpu: int = 64
+    tuner_type: str = "gridsearch"
+
+
+@dataclass
+class ElasticityConfig:
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 20
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.1
+
+
+@dataclass
+class SequenceParallelConfig:
+    sp_size: int = 1
+    mode: str = "ulysses"  # ulysses | ring
+
+
+class DeepSpeedConfig:
+    """Parsed + validated ds_config. Accepts dict or json path.
+
+    Parity: deepspeed.runtime.config.DeepSpeedConfig — including the
+    batch-triangle resolution: train_batch_size =
+    micro_batch_per_gpu * gradient_accumulation_steps * dp_world_size.
+    """
+
+    def __init__(self, config, dp_world_size: Optional[int] = None):
+        if isinstance(config, (str, os.PathLike)):
+            with open(config, "r") as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise DeepSpeedConfigError(f"config must be dict or path, got {type(config)}")
+        self.raw: Dict[str, Any] = copy.deepcopy(config)
+        d = self.raw
+
+        # ---- batch triangle -------------------------------------------------
+        self.train_batch_size = _get(d, "train_batch_size")
+        self.train_micro_batch_size_per_gpu = _get(d, "train_micro_batch_size_per_gpu")
+        self.gradient_accumulation_steps = _get(d, "gradient_accumulation_steps")
+        self._dp_world_size = dp_world_size
+        if dp_world_size is not None:
+            self._resolve_batch_triangle(dp_world_size)
+
+        self.steps_per_print = int(_get(d, "steps_per_print", 10) or 10)
+        self.wall_clock_breakdown = bool(_get(d, "wall_clock_breakdown", False))
+        self.dump_state = bool(_get(d, "dump_state", False))
+        self.prescale_gradients = bool(_get(d, "prescale_gradients", False))
+        self.gradient_predivide_factor = float(_get(d, "gradient_predivide_factor", 1.0) or 1.0)
+        self.gradient_clipping = float(_get(d, "gradient_clipping", 0.0) or 0.0)
+        self.communication_data_type = _get(d, "communication_data_type")
+        self.seed = int(_get(d, "seed", 1234) or 1234)
+        self.memory_breakdown = bool(_get(d, "memory_breakdown", False))
+        self.zero_allow_untested_optimizer = bool(_get(d, "zero_allow_untested_optimizer", True))
+
+        # ---- sections -------------------------------------------------------
+        opt = d.get("optimizer") or {}
+        self.optimizer = OptimizerConfig(
+            type=str(opt.get("type", "adamw")).lower(), params=dict(opt.get("params", {}))
+        )
+        sched = d.get("scheduler") or {}
+        self.scheduler = SchedulerConfig(
+            type=(sched.get("type") or None), params=dict(sched.get("params", {}))
+        )
+        self.fp16 = _parse_dc(FP16Config, d.get("fp16"))
+        self.bf16 = _parse_dc(BF16Config, d.get("bf16"))
+        zo = dict(d.get("zero_optimization") or {})
+        zo["offload_optimizer"] = _parse_dc(OffloadConfig, zo.get("offload_optimizer"))
+        zo["offload_param"] = _parse_dc(OffloadConfig, zo.get("offload_param"))
+        self.zero_config = _parse_dc(ZeroConfig, zo)
+        self.activation_checkpointing = _parse_dc(
+            ActivationCheckpointingConfig, d.get("activation_checkpointing")
+        )
+        pipe = dict(d.get("pipeline") or {})
+        if "stages" not in pipe and "num_stages" in pipe:
+            pipe["stages"] = pipe.pop("num_stages")
+        self.pipeline = _parse_dc(PipelineConfig, pipe)
+        self.moe = _parse_dc(MoEConfig, d.get("moe"))
+        tp = d.get("tensor_parallel") or {}
+        if "autotp_size" in tp and "tp_size" not in tp:
+            tp = {"tp_size": tp["autotp_size"]}
+        self.tensor_parallel = _parse_dc(TensorParallelConfig, tp)
+        sp = d.get("sequence_parallel") or {}
+        if "sequence_parallel_size" in d:
+            sp.setdefault("sp_size", d["sequence_parallel_size"])
+        self.sequence_parallel = _parse_dc(SequenceParallelConfig, sp)
+        self.flops_profiler = _parse_dc(FlopsProfilerConfig, d.get("flops_profiler"))
+        self.comms_logger = _parse_dc(CommsLoggerConfig, d.get("comms_logger"))
+        self.monitor = MonitorConfig(
+            tensorboard=dict(d.get("tensorboard") or {}),
+            wandb=dict(d.get("wandb") or {}),
+            csv_monitor=dict(d.get("csv_monitor") or {}),
+        )
+        de = dict(d.get("data_efficiency") or {})
+        de_types = dict(de.get("data_routing") or {})
+        cl = dict((de.get("data_sampling") or {}).get("curriculum_learning") or {})
+        self.data_efficiency = DataEfficiencyConfig(
+            enabled=bool(de.get("enabled", False)),
+            seed=int(de.get("seed", 1234)),
+            curriculum_learning=_parse_dc(CurriculumConfig, cl or d.get("curriculum_learning")),
+            random_ltd=_parse_dc(RandomLTDConfig, de_types.get("random_ltd")),
+        )
+        self.compression = _parse_dc(CompressionConfig, d.get("compression_training"))
+        self.autotuning = _parse_dc(AutotuningConfig, d.get("autotuning"))
+        self.elasticity = _parse_dc(ElasticityConfig, d.get("elasticity"))
+
+        self._validate()
+
+    # -- helpers --------------------------------------------------------------
+    def _resolve_batch_triangle(self, dp_world_size: int) -> None:
+        tb, mb, ga = (
+            self.train_batch_size,
+            self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps,
+        )
+        if tb is not None and mb is not None and ga is not None:
+            if tb != mb * ga * dp_world_size:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} != micro_batch {mb} * grad_accum {ga} * dp {dp_world_size}"
+                )
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp_world_size) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch {mb} * dp {dp_world_size}"
+                )
+            ga = tb // (mb * dp_world_size)
+        elif tb is not None and ga is not None:
+            if tb % (ga * dp_world_size) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by grad_accum {ga} * dp {dp_world_size}"
+                )
+            mb = tb // (ga * dp_world_size)
+        elif mb is not None:
+            ga = ga or 1
+            tb = mb * ga * dp_world_size
+        elif tb is not None:
+            ga = 1
+            if tb % dp_world_size != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by dp world size {dp_world_size}"
+                )
+            mb = tb // dp_world_size
+        else:
+            tb, mb, ga = dp_world_size, 1, 1
+        self.train_batch_size, self.train_micro_batch_size_per_gpu = int(tb), int(mb)
+        self.gradient_accumulation_steps = int(ga)
+
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        self._dp_world_size = dp_world_size
+        self._resolve_batch_triangle(dp_world_size)
+
+    def _validate(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        self.zero_config.validate()
+        if self.gradient_clipping < 0:
+            raise DeepSpeedConfigError("gradient_clipping must be >= 0")
+        if self.pipeline.stages < 1:
+            raise DeepSpeedConfigError("pipeline.stages must be >= 1")
+        if self.zero_config.stage >= 2 and self.pipeline.stages > 1:
+            # reference: PipelineEngine asserts ZeRO-2/3 unsupported with pipeline
+            raise DeepSpeedConfigError(
+                "ZeRO stages 2/3 are incompatible with pipeline parallelism (reference parity)"
+            )
+
+    # dtype policy ------------------------------------------------------------
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self.raw)
+
+
+def _parse_dc(cls, section):
+    """Build dataclass ``cls`` from dict ``section``, ignoring unknown keys."""
+    section = dict(section or {})
+    names = {f.name for f in cls.__dataclass_fields__.values()} if hasattr(cls, "__dataclass_fields__") else set()
+    known = {}
+    for k, v in section.items():
+        if k in names:
+            known[k] = v
+    try:
+        return cls(**known)
+    except TypeError as e:  # pragma: no cover
+        raise DeepSpeedConfigError(f"bad config section for {cls.__name__}: {e}")

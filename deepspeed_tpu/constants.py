@@ -1,0 +1,18 @@
+"""Shared constants. Parity: deepspeed/constants.py + runtime/constants.py."""
+
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"
+ROUTE_PREDICT = "predict"
+
+# Mesh axis names (see comm.topology.AXIS_ORDER for ordering rationale).
+DP_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+PP_AXIS = "pp"
+EP_AXIS = "ep"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+
+# Gradient-reduction dtype default (reference: communication_data_type).
+DEFAULT_COMM_DTYPE = None  # None => same as compute dtype
+
+TORCH_DISTRIBUTED_DEFAULT_PORT = 29500  # kept for launcher arg parity

@@ -1,0 +1,3 @@
+from .logging import log_dist, logger, warning_once  # noqa: F401
+from .timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401
+from .tree import global_norm, tree_cast, tree_size, tree_zeros_like  # noqa: F401

@@ -1,0 +1,101 @@
+"""Wall-clock timers.
+
+Parity: deepspeed/utils/timer.py (SynchronizedWallClockTimer, ThroughputTimer).
+On TPU, "synchronized" means blocking on device work via
+``jax.block_until_ready`` before reading the host clock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from .logging import logger
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self.elapsed_total = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self, barrier: bool = False, block_on=None) -> None:
+        if self._start is None:
+            return
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        self.elapsed_total += time.perf_counter() - self._start
+        self.count += 1
+        self._start = None
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self.elapsed_total
+        if reset:
+            self.elapsed_total = 0.0
+            self.count = 0
+        return value
+
+    def mean(self) -> float:
+        return self.elapsed_total / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry, mirroring DeepSpeed's timer groups."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: Optional[List[str]] = None, reset: bool = True) -> str:
+        names = names or sorted(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                t = self.timers[name]
+                parts.append(f"{name}: {t.elapsed(reset=False) * 1000.0:.2f}ms")
+                if reset:
+                    t.elapsed(reset=True)
+        line = "time (ms) | " + " | ".join(parts)
+        logger.info(line)
+        return line
+
+
+class ThroughputTimer:
+    """Tokens/samples-per-second tracker used by the engine's steps_per_print."""
+
+    def __init__(self, batch_size: int, start_step: int = 2):
+        self.batch_size = batch_size
+        self.start_step = start_step
+        self.step_count = 0
+        self.total_elapsed = 0.0
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, block_on=None) -> None:
+        if self._t0 is None:
+            return
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        self.step_count += 1
+        if self.step_count >= self.start_step:
+            self.total_elapsed += time.perf_counter() - self._t0
+        self._t0 = None
+
+    @property
+    def avg_samples_per_sec(self) -> float:
+        steps = max(self.step_count - self.start_step + 1, 1)
+        if self.total_elapsed == 0.0:
+            return 0.0
+        return self.batch_size * steps / self.total_elapsed

@@ -1,0 +1,38 @@
+"""Test harness: force a virtual 8-device CPU mesh before jax initialises.
+
+Mirrors the reference's unit-test strategy (tests/unit) of running
+world_size>1 logic on a single box — here via XLA host-platform devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The container's sitecustomize imports jax with JAX_PLATFORMS=axon before
+# conftest runs, so the env var alone is too late — force the config flag.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_comm_state():
+    yield
+    import deepspeed_tpu.comm as comm
+
+    comm.destroy_process_group()
+    comm.collectives.clear_comm_hooks()
+
+
+@pytest.fixture
+def devices8():
+    ds = jax.devices()
+    assert len(ds) == 8, f"expected 8 virtual devices, got {len(ds)}"
+    return ds

@@ -1,0 +1,132 @@
+"""Config parsing/validation tests. Model: reference tests/unit/runtime/test_ds_config_dict.py."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triangle_full():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+        },
+        dp_world_size=4,
+    )
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangle_infer_accum():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}, dp_world_size=4
+    )
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangle_infer_micro():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "gradient_accumulation_steps": 2}, dp_world_size=4
+    )
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_triangle_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {
+                "train_batch_size": 33,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 4,
+            },
+            dp_world_size=4,
+        )
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_section_defaults_and_offload():
+    cfg = DeepSpeedConfig(
+        {
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu"},
+                "offload_param": {"device": "cpu"},
+            }
+        }
+    )
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.offload_optimizer.enabled
+    assert cfg.zero_config.offload_param.enabled
+    assert cfg.zero_enabled
+
+
+def test_offload_param_requires_stage3():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"zero_optimization": {"stage": 2, "offload_param": {"device": "cpu"}}}
+        )
+
+
+def test_zero23_incompatible_with_pipeline():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"zero_optimization": {"stage": 2}, "pipeline": {"stages": 2}})
+
+
+def test_fp16_loss_scale_knobs():
+    cfg = DeepSpeedConfig(
+        {
+            "fp16": {
+                "enabled": True,
+                "initial_scale_power": 8,
+                "loss_scale_window": 100,
+                "hysteresis": 3,
+            }
+        }
+    )
+    assert cfg.fp16.dynamic
+    assert cfg.fp16.initial_scale == 256.0
+    assert cfg.fp16.hysteresis == 3
+
+
+def test_config_from_json_path(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(
+        json.dumps(
+            {
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+                "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+                "bf16": {"enabled": True},
+                "gradient_clipping": 1.0,
+            }
+        )
+    )
+    cfg = DeepSpeedConfig(str(p), dp_world_size=2)
+    assert cfg.train_batch_size == 8
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.optimizer.lr == 3e-4
+    assert cfg.optimizer.betas == (0.9, 0.95)
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.gradient_clipping == 1.0
+    import jax.numpy as jnp
+
+    assert cfg.compute_dtype == jnp.bfloat16
+
+
+def test_unknown_keys_ignored():
+    cfg = DeepSpeedConfig({"zero_optimization": {"stage": 1, "some_future_knob": 7}})
+    assert cfg.zero_config.stage == 1
+
+
+def test_auto_values_treated_as_unset():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": "auto", "train_micro_batch_size_per_gpu": 2}, dp_world_size=4
+    )
+    assert cfg.train_batch_size == 8

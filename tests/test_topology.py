@@ -1,0 +1,67 @@
+"""MeshTopology tests. Model: reference tests/unit/runtime/pipe/test_topology.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.topology import (
+    MeshTopology,
+    ParallelDims,
+    PipeModelDataParallelTopology,
+)
+
+
+def test_dp_inferred(devices8):
+    topo = MeshTopology(ParallelDims(tp=2))
+    assert topo.tp_size == 2
+    assert topo.dp_size == 4
+    assert topo.world_size == 8
+    assert topo.mesh.shape["tp"] == 2
+
+
+def test_bad_dims_raise(devices8):
+    with pytest.raises(ValueError):
+        MeshTopology(ParallelDims(dp=3, tp=2))
+    with pytest.raises(ValueError):
+        MeshTopology(ParallelDims(tp=3))
+
+
+def test_rank_coord_roundtrip(devices8):
+    topo = MeshTopology(ParallelDims(pp=2, tp=2))
+    for rank in range(8):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord) == rank
+
+
+def test_axis_comm_lists_partition_world(devices8):
+    topo = MeshTopology(ParallelDims(pp=2, tp=2))
+    lists = topo.get_axis_comm_lists("tp")
+    assert len(lists) == 4
+    flat = sorted(r for lst in lists for r in lst)
+    assert flat == list(range(8))
+    for lst in lists:
+        assert len(lst) == 2
+
+
+def test_reference_topology_alias(devices8):
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.pp_size == 2 and topo.tp_size == 2 and topo.dp_size == 2
+    # reference alias axes resolve
+    assert topo.get_dim("pipe") == 2
+    assert topo.get_dim("model") == 2
+    assert topo.get_dim("data") == 2
+
+
+def test_batch_spec(devices8):
+    topo = MeshTopology(ParallelDims(fsdp=2, sp=2))
+    spec = topo.batch_spec()
+    assert spec[0] == ("dp", "fsdp")
+    assert spec[1] == "sp"
+
+
+def test_tp_innermost_adjacency(devices8):
+    """tp groups must be adjacent device indices (ICI locality)."""
+    topo = MeshTopology(ParallelDims(tp=2))
+    grid = np.asarray(topo.mesh.devices)
+    flat = grid.reshape(-1)
+    for i in range(0, 8, 2):
+        assert flat[i].id + 1 == flat[i + 1].id
