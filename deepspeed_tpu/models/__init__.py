@@ -1,0 +1,21 @@
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerModel,
+    make_lm_batch,
+)
+from .gpt2 import gpt2, gpt2_config  # noqa: F401
+from .llama import llama, llama_config  # noqa: F401
+from .bloom import bloom, bloom_config  # noqa: F401
+from .mixtral import mixtral, mixtral_config  # noqa: F401
+
+MODEL_REGISTRY = {
+    "gpt2": gpt2,
+    "llama": llama,
+    "bloom": bloom,
+    "mixtral": mixtral,
+}
+
+
+def get_model(family: str, size: str = None, **overrides):  # noqa: D103
+    fn = MODEL_REGISTRY[family]
+    return fn(size, **overrides) if size else fn(**overrides)

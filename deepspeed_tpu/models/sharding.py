@@ -1,0 +1,63 @@
+"""Activation/parameter sharding context for model code.
+
+Models call :func:`constrain` on activations; when the engine has installed a
+mesh (via :func:`use_topology`), this lowers to
+``jax.lax.with_sharding_constraint`` so XLA propagates TP/SP/DP layouts and
+inserts the collectives. With no mesh installed (single-device unit tests),
+it is a no-op — model code never branches on distribution.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..comm.topology import MeshTopology
+
+_local = threading.local()
+
+
+def current_topology() -> Optional[MeshTopology]:
+    return getattr(_local, "topology", None)
+
+
+@contextlib.contextmanager
+def use_topology(topology: Optional[MeshTopology]):
+    prev = current_topology()
+    _local.topology = topology
+    try:
+        yield topology
+    finally:
+        _local.topology = prev
+
+
+def _filter_spec(spec: PartitionSpec, topo: MeshTopology) -> PartitionSpec:
+    """Drop axes of size 1 so specs stay valid on degenerate meshes."""
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if topo.sizes.get(a, 1) > 1)
+            return kept if kept else None
+        return entry if topo.sizes.get(entry, 1) > 1 else None
+
+    return PartitionSpec(*(keep(e) for e in spec))
+
+
+def constrain(x, *spec_entries):
+    """Constrain activation sharding; no-op outside an installed topology."""
+    topo = current_topology()
+    if topo is None or topo.world_size == 1:
+        return x
+    spec = _filter_spec(PartitionSpec(*spec_entries), topo)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
+
+
+def batch_seq_spec() -> tuple:
+    """Standard activation layout entries: (batch over dp+fsdp, seq over sp)."""
+    return (("dp", "fsdp"), "sp")

@@ -1,0 +1,448 @@
+"""TPU-native decoder transformer core.
+
+One configurable functional decoder covers the reference's benchmark model
+families (GPT-2, Llama, BLOOM, Mixtral — see models/{gpt2,llama,bloom,
+mixtral}.py presets). Where the reference wraps torch nn.Modules, here a
+model is (init, apply, loss, partition_specs) over an explicit parameter
+pytree:
+
+- layers are *stacked* along a leading L dim and applied with ``lax.scan``
+  (fast XLA compiles at depth; the pipeline engine re-slices the same stack
+  across pp stages)
+- activations carry sharding constraints (models/sharding.py) so TP/SP/DP
+  layouts propagate and XLA inserts the collectives
+- attention is pluggable (ops.attention registry) so the Pallas flash kernel
+  and ring/Ulysses sequence-parallel variants drop in without model changes
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # None => MHA
+    head_dim: Optional[int] = None
+    intermediate_size: Optional[int] = None
+    max_seq_len: int = 2048
+    pos_embedding: str = "rope"  # rope | learned | alibi | none
+    rope_theta: float = 10000.0
+    norm: str = "rmsnorm"  # rmsnorm | layernorm
+    norm_eps: float = 1e-5
+    activation: str = "swiglu"  # swiglu | gelu | gelu_new
+    use_bias: bool = False
+    tie_embeddings: bool = False
+    embed_norm: bool = False  # BLOOM's word-embedding layernorm
+    initializer_range: float = 0.02
+    # MoE (Mixtral): >0 experts turns the MLP into a routed expert layer.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_loss_coef: float = 0.01
+    moe_z_loss_coef: float = 1e-3
+    name: str = "transformer"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def num_params(self) -> int:
+        """Analytic parameter count (for flops profiler / partition planner)."""
+        d, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        ln_width = 2 * d if self.norm == "layernorm" else d  # scale (+bias)
+        qkvo = d * self.num_heads * self.hd * 2 + d * self.kv_heads * self.hd * 2
+        if self.activation == "swiglu":
+            mlp = 3 * d * self.ffn
+        else:
+            mlp = 2 * d * self.ffn
+        if self.is_moe:
+            mlp *= self.num_experts
+            mlp += d * self.num_experts  # router
+        biases = 0
+        if self.use_bias:
+            biases += self.num_heads * self.hd + 2 * self.kv_heads * self.hd + d
+            if not self.is_moe and self.activation != "swiglu":
+                biases += self.ffn + d
+        per_layer = qkvo + mlp + biases + 2 * ln_width
+        embed = v * d + (self.max_seq_len * d if self.pos_embedding == "learned" else 0)
+        if self.embed_norm:
+            embed += ln_width
+        head = 0 if self.tie_embeddings else v * d
+        return L * per_layer + embed + head + ln_width
+
+
+# -----------------------------------------------------------------------------
+# init
+# -----------------------------------------------------------------------------
+def init(cfg: TransformerConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
+    std = cfg.initializer_range
+    keys = jax.random.split(rng, 16)
+    d, hd, nh, nkv, f = cfg.hidden_size, cfg.hd, cfg.num_heads, cfg.kv_heads, cfg.ffn
+    L = cfg.num_layers
+
+    def nrm(key, *shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    def norm_params(with_bias: bool, lead=()):
+        p = {"scale": jnp.ones((*lead, d), dtype)}
+        if with_bias:
+            p["bias"] = jnp.zeros((*lead, d), dtype)
+        return p
+
+    ln_bias = cfg.norm == "layernorm"
+    params: Params = {
+        "embed": {"tok": nrm(keys[0], cfg.vocab_size, d)},
+        "final_norm": norm_params(ln_bias),
+    }
+    if cfg.pos_embedding == "learned":
+        params["embed"]["pos"] = nrm(keys[1], cfg.max_seq_len, d)
+    if cfg.embed_norm:
+        params["embed_norm"] = norm_params(ln_bias)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = nrm(keys[2], d, cfg.vocab_size)
+
+    # residual-branch output projections get depth-scaled init (GPT-2 paper)
+    out_scale = std / math.sqrt(2 * L)
+    lk = jax.random.split(keys[3], 12)
+    attn = {
+        "wq": nrm(lk[0], L, d, nh * hd),
+        "wk": nrm(lk[1], L, d, nkv * hd),
+        "wv": nrm(lk[2], L, d, nkv * hd),
+        "wo": nrm(lk[3], L, nh * hd, d, scale=out_scale),
+    }
+    if cfg.use_bias:
+        for nm, width in (("bq", nh * hd), ("bk", nkv * hd), ("bv", nkv * hd), ("bo", d)):
+            attn[nm] = jnp.zeros((L, width), dtype)
+
+    if cfg.is_moe:
+        E = cfg.num_experts
+        mlp = {
+            "router": nrm(lk[4], L, d, E),
+            "wi": nrm(lk[5], L, E, d, f),
+            "wo": nrm(lk[6], L, E, f, d, scale=out_scale),
+        }
+        if cfg.activation == "swiglu":
+            mlp["wg"] = nrm(lk[7], L, E, d, f)
+    else:
+        mlp = {"wi": nrm(lk[5], L, d, f), "wo": nrm(lk[6], L, f, d, scale=out_scale)}
+        if cfg.activation == "swiglu":
+            mlp["wg"] = nrm(lk[7], L, d, f)
+        if cfg.use_bias:
+            mlp["bi"] = jnp.zeros((L, f), dtype)
+            mlp["bo"] = jnp.zeros((L, d), dtype)
+
+    params["layers"] = {
+        "ln1": norm_params(ln_bias, (L,)),
+        "ln2": norm_params(ln_bias, (L,)),
+        "attn": attn,
+        "mlp": mlp,
+    }
+    return params
+
+
+# -----------------------------------------------------------------------------
+# building blocks
+# -----------------------------------------------------------------------------
+def _norm(cfg: TransformerConfig, p: Params, x: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        from ..ops.normalization import rmsnorm
+
+        return rmsnorm(x32, p["scale"].astype(jnp.float32), cfg.norm_eps).astype(x.dtype)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + cfg.norm_eps)
+    out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float):
+    """Rotary embeddings; q/k: [B, S, H, hd], positions: [B, S]."""
+    hd = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """BLOOM's ALiBi head slopes (power-of-2 interpolation)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base**(i + 1) for i in range(closest)]
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base**(2 * i + 1) for i in range(num_heads - closest)]
+    return np.asarray(slopes, dtype=np.float32)
+
+
+def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array,
+               segment_ids: Optional[jax.Array]) -> jax.Array:
+    from ..ops.attention import attention as attn_op
+
+    B, S, d = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
+    q = jnp.einsum("bsd,dh->bsh", x, p["wq"]).reshape(B, S, nh, hd)
+    k = jnp.einsum("bsd,dh->bsh", x, p["wk"]).reshape(B, S, nkv, hd)
+    v = jnp.einsum("bsd,dh->bsh", x, p["wv"]).reshape(B, S, nkv, hd)
+    if cfg.use_bias:
+        q = q + p["bq"].reshape(1, 1, nh, hd)
+        k = k + p["bk"].reshape(1, 1, nkv, hd)
+        v = v + p["bv"].reshape(1, 1, nkv, hd)
+    if cfg.pos_embedding == "rope":
+        q, k = _rope(q, k, positions, cfg.rope_theta)
+    q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
+    k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
+    v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
+
+    bias = None
+    if cfg.pos_embedding == "alibi":
+        slopes = jnp.asarray(alibi_slopes(nh))
+        rel = positions[:, None, :].astype(jnp.float32) - positions[:, :, None].astype(jnp.float32)
+        bias = slopes[None, :, None, None] * (-jnp.abs(rel))[:, None, :, :]  # [B,H,S,S]
+
+    out = attn_op(q, k, v, causal=True, bias=bias, segment_ids=segment_ids)  # [B,S,H,hd]
+    out = out.reshape(B, S, nh * hd)
+    out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+    if cfg.use_bias:
+        out = out + p["bo"]
+    return out
+
+
+def _act(cfg: TransformerConfig, x: jax.Array) -> jax.Array:
+    if cfg.activation == "gelu_new":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def _mlp(cfg: TransformerConfig, p: Params, x: jax.Array, rng: Optional[jax.Array],
+         train: bool) -> Tuple[jax.Array, jax.Array]:
+    """Returns (output, aux_loss). Dense MLP or routed MoE expert layer."""
+    if cfg.is_moe:
+        from ..moe.sharded_moe import moe_layer
+
+        return moe_layer(cfg, p, x, rng, train)
+    h = jnp.einsum("bsd,df->bsf", x, p["wi"])
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("bsd,df->bsf", x, p["wg"])
+        h = jax.nn.silu(g) * h
+    else:
+        if cfg.use_bias:
+            h = h + p["bi"]
+        h = _act(cfg, h)
+    h = constrain(h, ("dp", "fsdp"), "sp", "tp")
+    out = jnp.einsum("bsf,fd->bsd", h, p["wo"])
+    if cfg.use_bias and not cfg.activation == "swiglu":
+        out = out + p["bo"]
+    return out, jnp.zeros((), jnp.float32)
+
+
+def _block(cfg: TransformerConfig, layer: Params, x: jax.Array, positions: jax.Array,
+           segment_ids: Optional[jax.Array], rng: Optional[jax.Array], train: bool):
+    h = _attention(cfg, layer["attn"], _norm(cfg, layer["ln1"], x), positions, segment_ids)
+    x = x + h
+    x = constrain(x, ("dp", "fsdp"), "sp", None)
+    m, aux = _mlp(cfg, layer["mlp"], _norm(cfg, layer["ln2"], x), rng, train)
+    x = x + m
+    x = constrain(x, ("dp", "fsdp"), "sp", None)
+    return x, aux
+
+
+def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
+                      positions: jax.Array, segment_ids, rng, train: bool,
+                      remat_policy: Optional[str] = None):
+    """Scan the stacked layer params over the sequence of blocks."""
+    num_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+
+    def body(carry, inp):
+        x, aux = carry
+        layer, key = inp
+        out, a = _block(cfg, layer, x, positions, segment_ids, key, train)
+        return (out, aux + a), None
+
+    if remat_policy and remat_policy != "none":
+        from ..runtime.activation_checkpointing import policy_by_name
+
+        body = jax.checkpoint(body, policy=policy_by_name(remat_policy), prevent_cse=False)
+
+    keys = (
+        jax.random.split(rng, num_layers)
+        if rng is not None
+        else jnp.zeros((num_layers, 2), jnp.uint32)
+    )
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), (layers, keys))
+    return x, aux
+
+
+# -----------------------------------------------------------------------------
+# forward / loss
+# -----------------------------------------------------------------------------
+def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
+          dtype=jnp.bfloat16, train: bool = False, rng: Optional[jax.Array] = None,
+          positions: Optional[jax.Array] = None, segment_ids=None,
+          remat_policy: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass → (logits fp32 [B,S,V], moe_aux_loss)."""
+    B, S = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    cast = lambda t: jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
+    )
+    x = cast(params["embed"]["tok"])[input_ids]
+    if cfg.pos_embedding == "learned":
+        x = x + cast(params["embed"]["pos"])[positions]
+    if cfg.embed_norm:
+        x = _norm(cfg, cast(params["embed_norm"]), x)
+    x = constrain(x, ("dp", "fsdp"), "sp", None)
+
+    x, aux = apply_layer_stack(
+        cfg, cast(params["layers"]), x, positions, segment_ids, rng, train, remat_policy
+    )
+    x = _norm(cfg, cast(params["final_norm"]), x)
+    head = params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+    logits = constrain(logits, ("dp", "fsdp"), "sp", "tp")
+    return logits, aux
+
+
+def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array], *,
+            dtype=jnp.bfloat16, train: bool = True, rng=None,
+            remat_policy: Optional[str] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy (fp32), labels < 0 are ignored (HF -100 style)."""
+    logits, aux = apply(
+        cfg, params, batch["input_ids"], dtype=dtype, train=train, rng=rng,
+        segment_ids=batch.get("segment_ids"), positions=batch.get("positions"),
+        remat_policy=remat_policy,
+    )
+    labels = batch["labels"]
+    mask = (labels >= 0).astype(jnp.float32)
+    safe_labels = jnp.maximum(labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    denom = jnp.maximum(mask.sum(), 1.0)
+    ce = nll.sum() / denom
+    total = ce + cfg.moe_aux_loss_coef * aux if cfg.is_moe else ce
+    return total, {"lm_loss": ce, "moe_aux_loss": aux, "tokens": denom}
+
+
+def make_lm_batch(input_ids: jax.Array, pad_id: int = -1) -> Dict[str, jax.Array]:
+    """Shift inputs into (input_ids, labels) next-token form."""
+    labels = jnp.concatenate(
+        [input_ids[:, 1:], jnp.full((input_ids.shape[0], 1), pad_id, input_ids.dtype)], axis=1
+    )
+    return {"input_ids": input_ids, "labels": labels}
+
+
+# -----------------------------------------------------------------------------
+# partition specs (Megatron TP + ZeRO param axes; see runtime/zero/partition.py
+# for how dp/fsdp axes are added per stage)
+# -----------------------------------------------------------------------------
+def tp_partition_specs(cfg: TransformerConfig, tp_divides_kv: bool = True) -> Params:
+    """Tensor-parallel PartitionSpec tree matching init()'s param pytree.
+
+    Column-parallel: qkv + mlp-in shard output dim over tp.
+    Row-parallel: attn-out + mlp-out shard input dim over tp.
+    Embeddings/lm_head shard vocab over tp (loss is vocab-parallel).
+    """
+    kv_tp = "tp" if tp_divides_kv else None
+    ln = {"scale": P(None, None)}
+    if cfg.norm == "layernorm":
+        ln["bias"] = P(None, None)
+    attn = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, kv_tp),
+        "wv": P(None, None, kv_tp),
+        "wo": P(None, "tp", None),
+    }
+    if cfg.use_bias:
+        attn.update({"bq": P(None, "tp"), "bk": P(None, kv_tp),
+                     "bv": P(None, kv_tp), "bo": P(None, None)})
+    if cfg.is_moe:
+        mlp = {
+            "router": P(None, None, None),
+            "wi": P(None, "ep", None, "tp"),
+            "wo": P(None, "ep", "tp", None),
+        }
+        if cfg.activation == "swiglu":
+            mlp["wg"] = P(None, "ep", None, "tp")
+    else:
+        mlp = {"wi": P(None, None, "tp"), "wo": P(None, "tp", None)}
+        if cfg.activation == "swiglu":
+            mlp["wg"] = P(None, None, "tp")
+        if cfg.use_bias:
+            mlp["bi"] = P(None, "tp")
+            mlp["bo"] = P(None, None)
+    specs: Params = {
+        "embed": {"tok": P("tp", None)},
+        "final_norm": dict(scale=P(None), **({"bias": P(None)} if cfg.norm == "layernorm" else {})),
+        "layers": {"ln1": ln, "ln2": ln, "attn": attn, "mlp": mlp},
+    }
+    if cfg.pos_embedding == "learned":
+        specs["embed"]["pos"] = P(None, None)
+    if cfg.embed_norm:
+        specs["embed_norm"] = specs["final_norm"]
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+class TransformerModel:
+    """Bundles (config, init, apply, loss, specs) — the engine's model protocol."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.config = cfg
+
+    def init(self, rng, dtype=jnp.float32):
+        return init(self.config, rng, dtype)
+
+    def apply(self, params, input_ids, **kw):
+        return apply(self.config, params, input_ids, **kw)
+
+    def loss(self, params, batch, **kw):
+        return loss_fn(self.config, params, batch, **kw)
+
+    def partition_specs(self, topology=None) -> Params:
+        tp = topology.tp_size if topology is not None else 1
+        kv_ok = tp <= 1 or (self.config.kv_heads % tp == 0)
+        return tp_partition_specs(self.config, tp_divides_kv=kv_ok)
+
+    def num_params(self) -> int:
+        return self.config.num_params()

@@ -1,0 +1,1 @@
+from .sharded_moe import moe_layer, top_k_gating  # noqa: F401

@@ -1,0 +1,116 @@
+"""Expert-parallel mixture-of-experts layer.
+
+Parity: deepspeed/moe/sharded_moe.py (TopKGate + MOELayer with its NCCL
+all-to-all dispatch). TPU-native design is the GShard/Switch dense-dispatch
+formulation: routing builds one-hot dispatch/combine tensors and the
+dispatch/combine "all-to-all" is an einsum whose output is sharding-
+constrained onto the ``ep`` mesh axis — XLA lowers the resharding to the
+same all-to-all the reference hand-codes, but fused and overlapped.
+
+Top-1/top-k gating with capacity factor, token dropping, load-balance aux
+loss and router z-loss match the reference's TopKGate semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.sharding import constrain
+
+
+def top_k_gating(
+    logits: jax.Array,  # [N, E] fp32
+    top_k: int,
+    capacity: int,
+    rng: Optional[jax.Array],
+    train: bool,
+    noise_std: float = 0.0,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Returns (dispatch [N,E,C] bool-ish, combine [N,E,C], aux metrics).
+
+    Parity: TopKGate.forward (deepspeed/moe/sharded_moe.py top1gating/top2gating):
+    softmax gates, top-k experts per token, positions via cumsum, overflow
+    tokens dropped, load-balance loss = E * mean(gate_frac * token_frac).
+    """
+    N, E = logits.shape
+    if train and noise_std > 0.0 and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+    gates = jax.nn.softmax(logits, axis=-1)  # [N, E]
+
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((N, E, capacity), jnp.bool_)
+    # running per-expert fill count is carried across the k selection rounds
+    fill = jnp.zeros((E,), jnp.int32)
+    masked_gates = gates
+    me = jnp.mean(gates, axis=0)  # gate fraction per expert
+    ce_acc = jnp.zeros((E,), jnp.float32)
+
+    for _ in range(top_k):
+        idx = jnp.argmax(masked_gates, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [N, E]
+        # position of each token within its chosen expert (this round)
+        pos_in_round = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        pos = pos_in_round + fill[None, :] * onehot
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
+        keep = pos_tok < capacity
+        gate_val = jnp.sum(gates * onehot, axis=-1)  # [N]
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity), capacity + 1)[:, :capacity]
+        contrib = onehot[:, :, None] * pos_oh[:, None, :]  # [N, E, C]
+        combine = combine + contrib * gate_val[:, None, None] * keep[:, None, None]
+        dispatch = dispatch | (contrib > 0) & keep[:, None, None]
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+        ce_acc = ce_acc + jnp.mean(onehot, axis=0)
+        masked_gates = masked_gates * (1.0 - onehot)  # exclude chosen expert next round
+
+    # renormalize combine weights over selected experts (top-2 reference behavior)
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), combine)
+
+    aux_loss = E * jnp.sum(me * (ce_acc / top_k))
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    dropped = 1.0 - jnp.sum(dispatch.astype(jnp.float32)) / (N * top_k)
+    metrics = {"aux_loss": aux_loss, "z_loss": z_loss, "drop_fraction": dropped}
+    return dispatch.astype(jnp.float32), combine, metrics
+
+
+def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool):
+    """Routed expert MLP. x: [B, S, D] → ([B, S, D], aux_loss scalar).
+
+    Expert compute is laid out [E, C, D] and constrained to the ``ep`` axis;
+    combined aux = load-balance + z-loss (coefs applied by caller/config).
+    """
+    B, S, D = x.shape
+    E = cfg.num_experts
+    N = B * S
+    cap_factor = cfg.moe_capacity_factor if train else max(cfg.moe_capacity_factor, 2.0)
+    capacity = max(4, int(math.ceil(cap_factor * cfg.moe_top_k * N / E)))
+
+    tokens = x.reshape(N, D)
+    router_logits = jnp.einsum(
+        "nd,de->ne", tokens.astype(jnp.float32), p["router"].astype(jnp.float32)
+    )
+    dispatch, combine, metrics = top_k_gating(
+        router_logits, cfg.moe_top_k, capacity, rng, train
+    )
+
+    # dispatch: [N,E,C] x [N,D] -> [E,C,D], sharded over ep
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
+    expert_in = constrain(expert_in, "ep", None, None)
+
+    h = jnp.einsum("ecd,edf->ecf", expert_in, p["wi"])
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("ecd,edf->ecf", expert_in, p["wg"])
+        h = jax.nn.silu(g) * h
+    else:
+        h = jax.nn.gelu(h)
+    h = constrain(h, "ep", None, "tp")
+    expert_out = jnp.einsum("ecf,efd->ecd", h, p["wo"])
+    expert_out = constrain(expert_out, "ep", None, None)
+
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    aux = metrics["aux_loss"] + (cfg.moe_z_loss_coef / max(cfg.moe_aux_loss_coef, 1e-9)) * metrics["z_loss"]
+    return out.reshape(B, S, D), aux

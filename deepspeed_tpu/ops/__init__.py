@@ -1,0 +1,2 @@
+from .attention import attention, set_attention_impl, get_attention_impl  # noqa: F401
+from .normalization import rmsnorm  # noqa: F401

@@ -1,0 +1,68 @@
+"""Attention op registry.
+
+Parity: the reference's attention kernels live in csrc/transformer and
+csrc/flash_attn-style fused ops; here the default is an XLA einsum softmax
+(fuses well on TPU already), and ``set_attention_impl("flash")`` swaps in the
+Pallas flash kernel (ops/pallas/flash_attention.py) without touching models.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+_IMPLS: Dict[str, Callable] = {}
+_CURRENT = "xla"
+
+NEG_INF = -1e30
+
+
+def register_attention_impl(name: str, fn: Callable) -> None:
+    _IMPLS[name] = fn
+
+
+def set_attention_impl(name: str) -> None:
+    global _CURRENT
+    if name not in _IMPLS:
+        raise KeyError(f"unknown attention impl {name!r}; have {sorted(_IMPLS)}")
+    _CURRENT = name
+
+
+def get_attention_impl() -> str:
+    return _CURRENT
+
+
+def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+    """Reference attention. q: [B,S,H,hd], k/v: [B,S,KV,hd] (GQA aware).
+
+    fp32 softmax accumulation; returns [B,S,H,hd] in q.dtype.
+    """
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        assert H % KV == 0, f"GQA heads {H} not divisible by kv heads {KV}"
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        qpos = jnp.arange(S)[:, None]
+        kpos = jnp.arange(S)[None, :]
+        logits = jnp.where((kpos > qpos)[None, None], NEG_INF, logits)
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(same[:, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+register_attention_impl("xla", xla_attention)
+
+
+def attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+    return _IMPLS[_CURRENT](q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)

@@ -1,0 +1,25 @@
+"""Normalization ops. XLA path here; Pallas fused kernels in ops/pallas/
+register themselves on TPU (reference parity: csrc fused layer_norm kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_USE_PALLAS = False
+
+
+def enable_pallas(flag: bool = True) -> None:
+    global _USE_PALLAS
+    _USE_PALLAS = flag
+
+
+def rmsnorm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    if _USE_PALLAS:
+        from .pallas.rmsnorm import rmsnorm as pallas_rmsnorm
+
+        return pallas_rmsnorm(x, scale, eps)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * lax.rsqrt(var + eps) * scale

@@ -1,0 +1,56 @@
+"""Activation checkpointing (rematerialisation).
+
+Parity: deepspeed/runtime/activation_checkpointing/checkpointing.py. The
+reference re-runs forward chunks in backward and can partition/offload the
+saved activations across ranks; on TPU this is ``jax.checkpoint`` with a
+saveable-policy — XLA re-materialises inside the fused backward, and
+``offload_host`` maps saved residuals to host memory (the cpu_checkpointing
+equivalent).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_POLICIES = {}
+
+
+def _register_policies():
+    cp = jax.checkpoint_policies
+    _POLICIES.update(
+        {
+            # save nothing: recompute the whole block in backward
+            "full": cp.nothing_saveable,
+            # save matmul outputs (cheap recompute for elementwise only)
+            "dots_saveable": cp.dots_saveable,
+            "dots_with_no_batch_dims": cp.dots_with_no_batch_dims_saveable,
+            # save only named attention outputs (see models/transformer.py)
+            "attn_only": cp.save_only_these_names("attn_out"),
+            "nothing": cp.nothing_saveable,
+        }
+    )
+    if hasattr(cp, "save_and_offload_only_these_names"):
+        _POLICIES["offload_host"] = cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["attn_out", "block_out"],
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+
+
+_register_policies()
+
+
+def policy_by_name(name: str):
+    if name in ("none", None):
+        return None
+    if name not in _POLICIES:
+        raise KeyError(f"unknown remat policy {name!r}; have {sorted(_POLICIES)}")
+    return _POLICIES[name]
+
+
+def checkpoint_fn(fn, policy_name: str = "full"):
+    """Wrap ``fn`` with jax.checkpoint under the named policy."""
+    if policy_name in ("none", None):
+        return fn
+    return jax.checkpoint(fn, policy=policy_by_name(policy_name), prevent_cse=False)
