@@ -1,0 +1,86 @@
+"""Data loading.
+
+Parity: deepspeed/runtime/dataloader.py (DeepSpeedDataLoader,
+RepeatingLoader). SPMD note: every host feeds the *global* batch (the jitted
+step shards it over dp/fsdp/sp via in_shardings); per-rank samplers from the
+reference become a deterministic global permutation here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class RepeatingLoader:
+    """Parity: deepspeed.runtime.dataloader.RepeatingLoader — wraps an
+    iterable and restarts it on StopIteration."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self._iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = iter(self.loader)
+            return next(self._iter)
+
+
+class DeepSpeedDataLoader:
+    """Batches a dict-of-arrays (or array) dataset into global batches.
+
+    ``curriculum_fn(step) -> seq_len`` optionally truncates sequences
+    (data-efficiency curriculum parity).
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 1234,
+        drop_last: bool = True,
+        curriculum_fn=None,
+    ):
+        if isinstance(dataset, (np.ndarray, jax.Array)):
+            dataset = {"input_ids": dataset}
+        self.data = {k: np.asarray(v) for k, v in dataset.items()}
+        lengths = {len(v) for v in self.data.values()}
+        assert len(lengths) == 1, f"ragged dataset fields: { {k: len(v) for k, v in self.data.items()} }"
+        self.n = lengths.pop()
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.curriculum_fn = curriculum_fn
+        self.epoch = 0
+        self.global_step = 0
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.arange(self.n)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(self.n)
+        self.epoch += 1
+        for i in range(len(self)):
+            idx = order[i * self.batch_size : (i + 1) * self.batch_size]
+            batch = {k: v[idx] for k, v in self.data.items()}
+            if self.curriculum_fn is not None:
+                seqlen = int(self.curriculum_fn(self.global_step))
+                batch = {
+                    k: (v[:, :seqlen] if v.ndim >= 2 else v) for k, v in batch.items()
+                }
+            self.global_step += 1
+            yield batch

@@ -1,0 +1,133 @@
+"""Learning-rate schedules.
+
+Parity: deepspeed/runtime/lr_schedules.py — WarmupLR, WarmupDecayLR,
+WarmupCosineLR, OneCycle, LRRangeTest, expressed as pure step→lr functions
+(optax-schedule compatible, traced inside the jitted train step).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]
+
+
+def warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1e-3, warmup_num_steps=1000,
+              warmup_type="log", **_):
+    """WarmupLR: warm up then hold at warmup_max_lr."""
+    warmup_num_steps = max(warmup_num_steps, 1)
+
+    def schedule(step):
+        s = step.astype(jnp.float32) + 1.0
+        if warmup_type == "log":
+            frac = jnp.log(s) / math.log(max(warmup_num_steps, 2))
+        else:
+            frac = s / float(warmup_num_steps)
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * jnp.clip(frac, 0.0, 1.0)
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps, warmup_min_lr=0.0, warmup_max_lr=1e-3,
+                    warmup_num_steps=1000, warmup_type="log", **_):
+    """WarmupDecayLR: warmup then linear decay to 0 at total_num_steps."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def schedule(step):
+        lr = base(step)
+        decay = jnp.clip(
+            (total_num_steps - step.astype(jnp.float32))
+            / max(total_num_steps - warmup_num_steps, 1),
+            0.0,
+            1.0,
+        )
+        past_warmup = step.astype(jnp.float32) >= warmup_num_steps
+        return jnp.where(past_warmup, warmup_max_lr * decay, lr)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps, warmup_min_ratio=0.0, warmup_num_steps=1000,
+                     cos_min_ratio=0.0001, lr=1e-3, **_):
+    """WarmupCosineLR: linear warmup then cosine decay to cos_min_ratio*lr."""
+
+    def schedule(step):
+        s = step.astype(jnp.float32)
+        warm = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.minimum(
+            s / max(warmup_num_steps, 1), 1.0
+        )
+        progress = jnp.clip(
+            (s - warmup_num_steps) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
+        )
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(math.pi * progress))
+        return lr * jnp.where(s < warmup_num_steps, warm, cos)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr, cycle_max_lr, cycle_first_step_size=2000,
+              cycle_second_step_size=None, decay_step_size=0, decay_lr_rate=0.0,
+              post_cycle_decay="linear", **_):
+    """OneCycle: triangular up/down then optional decay (reference semantics)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        s = step.astype(jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.minimum(
+            s / cycle_first_step_size, 1.0
+        )
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * jnp.clip(
+            (s - cycle_first_step_size) / max(second, 1), 0.0, 1.0
+        )
+        in_up = s < cycle_first_step_size
+        lr = jnp.where(in_up, up, down)
+        if decay_step_size > 0:
+            post = jnp.maximum(s - total_cycle, 0.0)
+            lr = jnp.where(
+                s > total_cycle,
+                cycle_min_lr / (1.0 + decay_lr_rate * post / decay_step_size),
+                lr,
+            )
+        return lr
+
+    return schedule
+
+
+def lr_range_test(lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                  lr_range_test_step_rate=1.0, lr_range_test_staircase=False, **_):
+    """LRRangeTest: linearly (or staircase) increasing LR probe."""
+
+    def schedule(step):
+        s = step.astype(jnp.float32)
+        interval = jnp.floor(s / lr_range_test_step_size) if lr_range_test_staircase else (
+            s / lr_range_test_step_size
+        )
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+_SCHEDULES = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+}
+
+
+def build_schedule(name: Optional[str], params: Dict[str, Any], base_lr: float) -> Schedule:
+    """Schedule factory; None → constant base_lr."""
+    if not name:
+        return lambda step: jnp.full((), base_lr, jnp.float32)
+    key = name.lower().replace("_", "")
+    if key not in _SCHEDULES:
+        raise KeyError(f"unknown scheduler {name!r}; have {sorted(_SCHEDULES)}")
+    params = dict(params)
+    if key == "warmupcosinelr":
+        params.setdefault("lr", base_lr)
+    return _SCHEDULES[key](**params)

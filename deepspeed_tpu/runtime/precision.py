@@ -1,0 +1,70 @@
+"""Mixed-precision policy + dynamic loss scaling.
+
+Parity: deepspeed/runtime/fp16/loss_scaler.py (DynamicLossScaler) and the
+fp16/bf16 optimizer wrappers. The scaler is a pytree carried inside the
+jitted train step (no host round-trip): overflow check → skip update, halve
+scale, honor hysteresis; growth after loss_scale_window good steps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array  # f32 scalar
+    good_steps: jax.Array  # i32
+    hysteresis_left: jax.Array  # i32
+
+
+def init_loss_scale(cfg: FP16Config, enabled: bool) -> LossScaleState:
+    scale = cfg.initial_scale if enabled else 1.0
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+        hysteresis_left=jnp.asarray(cfg.hysteresis, jnp.int32),
+    )
+
+
+def update_loss_scale(
+    state: LossScaleState, overflow: jax.Array, cfg: FP16Config, enabled: bool
+) -> LossScaleState:
+    """One reference-semantics scaler step (static no-op unless fp16)."""
+    if not enabled or not cfg.dynamic:
+        return state
+    scale, good, hyst = state
+    full_hyst = jnp.asarray(cfg.hysteresis, jnp.int32)
+
+    def on_overflow():
+        # reference: hysteresis absorbs overflows first; only then halve
+        can_halve = hyst <= 1
+        new_scale = jnp.where(can_halve, jnp.maximum(scale / 2.0, cfg.min_loss_scale), scale)
+        new_hyst = jnp.where(can_halve, hyst, hyst - 1)
+        return LossScaleState(new_scale, jnp.zeros((), jnp.int32), new_hyst)
+
+    def on_good():
+        grown = good + 1 >= cfg.loss_scale_window
+        new_scale = jnp.where(grown, scale * 2.0, scale)
+        new_good = jnp.where(grown, 0, good + 1)
+        if cfg.consecutive_hysteresis:
+            new_hyst = full_hyst  # refill every good step
+        else:
+            new_hyst = jnp.where(grown, full_hyst, hyst)  # refill only at growth
+        return LossScaleState(new_scale, new_good, new_hyst)
+
+    return jax.tree.map(
+        lambda a, b: jnp.where(overflow, a, b), on_overflow(), on_good()
+    )
+
+
+def grads_finite(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(finite).all()

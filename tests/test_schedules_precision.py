@@ -1,0 +1,151 @@
+"""LR schedule + loss-scaler unit tests. Model: reference
+tests/unit/runtime/test_lr_schedulers.py + fp16 loss scaler tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config import FP16Config, OptimizerConfig
+from deepspeed_tpu.runtime.lr_schedules import build_schedule
+from deepspeed_tpu.runtime.optimizers import build_optimizer
+from deepspeed_tpu.runtime.precision import (
+    init_loss_scale,
+    update_loss_scale,
+)
+
+
+def _lr(sched, step):
+    return float(sched(jnp.asarray(step, jnp.int32)))
+
+
+def test_warmup_lr_reaches_max():
+    s = build_schedule("WarmupLR", {"warmup_max_lr": 1e-3, "warmup_num_steps": 10}, 1e-3)
+    assert _lr(s, 0) < 1e-3
+    np.testing.assert_allclose(_lr(s, 10), 1e-3, rtol=1e-5)
+    np.testing.assert_allclose(_lr(s, 100), 1e-3, rtol=1e-5)
+
+
+def test_warmup_decay_hits_zero():
+    s = build_schedule(
+        "WarmupDecayLR",
+        {"warmup_max_lr": 1e-3, "warmup_num_steps": 10, "total_num_steps": 100},
+        1e-3,
+    )
+    assert _lr(s, 50) < 1e-3
+    np.testing.assert_allclose(_lr(s, 100), 0.0, atol=1e-9)
+
+
+def test_warmup_cosine():
+    s = build_schedule(
+        "WarmupCosineLR", {"warmup_num_steps": 10, "total_num_steps": 110}, 1e-3
+    )
+    np.testing.assert_allclose(_lr(s, 10), 1e-3, rtol=1e-4)
+    mid = _lr(s, 60)
+    assert 4e-4 < mid < 6e-4  # half way through cosine ≈ lr/2
+    assert _lr(s, 110) < 1e-6
+
+
+def test_one_cycle_peak_at_first_step_size():
+    s = build_schedule(
+        "OneCycle",
+        {"cycle_min_lr": 1e-4, "cycle_max_lr": 1e-3, "cycle_first_step_size": 10},
+        1e-3,
+    )
+    np.testing.assert_allclose(_lr(s, 10), 1e-3, rtol=1e-5)
+    np.testing.assert_allclose(_lr(s, 20), 1e-4, rtol=1e-5)
+
+
+def test_lr_range_test_grows():
+    s = build_schedule(
+        "LRRangeTest",
+        {"lr_range_test_min_lr": 1e-5, "lr_range_test_step_size": 10,
+         "lr_range_test_step_rate": 1.0},
+        1e-3,
+    )
+    assert _lr(s, 0) == pytest.approx(1e-5)
+    assert _lr(s, 100) > _lr(s, 10) > _lr(s, 0)
+
+
+def test_unknown_scheduler_raises():
+    with pytest.raises(KeyError):
+        build_schedule("NoSuchSched", {}, 1e-3)
+
+
+# ---- loss scaler -------------------------------------------------------------
+def _cfg(**kw):
+    return FP16Config(enabled=True, **kw)
+
+
+def test_scaler_halves_after_hysteresis():
+    cfg = _cfg(initial_scale_power=16, hysteresis=2)
+    st = init_loss_scale(cfg, True)
+    st = update_loss_scale(st, jnp.asarray(True), cfg, True)  # hysteresis eats one
+    assert float(st.scale) == 2.0**16
+    st = update_loss_scale(st, jnp.asarray(True), cfg, True)
+    assert float(st.scale) == 2.0**15
+
+
+def test_scaler_grows_after_window():
+    cfg = _cfg(initial_scale_power=10, loss_scale_window=3)
+    st = init_loss_scale(cfg, True)
+    for _ in range(3):
+        st = update_loss_scale(st, jnp.asarray(False), cfg, True)
+    assert float(st.scale) == 2.0**11
+
+
+def test_scaler_respects_min_scale():
+    cfg = _cfg(initial_scale_power=1, hysteresis=1, min_loss_scale=1.0)
+    st = init_loss_scale(cfg, True)
+    for _ in range(5):
+        st = update_loss_scale(st, jnp.asarray(True), cfg, True)
+    assert float(st.scale) == 1.0
+
+
+def test_alternating_overflow_still_halves():
+    """With consecutive_hysteresis=False, O,G,O,G must halve at the second
+    overflow (hysteresis only refills at the growth window)."""
+    cfg = _cfg(initial_scale_power=16, hysteresis=2, loss_scale_window=1000)
+    st = init_loss_scale(cfg, True)
+    st = update_loss_scale(st, jnp.asarray(True), cfg, True)  # O: hyst 2->1
+    st = update_loss_scale(st, jnp.asarray(False), cfg, True)  # G: no refill
+    st = update_loss_scale(st, jnp.asarray(True), cfg, True)  # O: halve
+    assert float(st.scale) == 2.0**15
+
+
+def test_consecutive_hysteresis_refills_on_good():
+    cfg = _cfg(initial_scale_power=16, hysteresis=2, consecutive_hysteresis=True)
+    st = init_loss_scale(cfg, True)
+    st = update_loss_scale(st, jnp.asarray(True), cfg, True)  # O: hyst 2->1
+    st = update_loss_scale(st, jnp.asarray(False), cfg, True)  # G: refill to 2
+    st = update_loss_scale(st, jnp.asarray(True), cfg, True)  # O: hyst 2->1 again
+    assert float(st.scale) == 2.0**16
+
+
+def test_static_scale_never_changes():
+    cfg = FP16Config(enabled=True, loss_scale=128.0)
+    st = init_loss_scale(cfg, True)
+    st2 = update_loss_scale(st, jnp.asarray(True), cfg, True)
+    assert float(st2.scale) == 128.0
+
+
+# ---- optimizer factory -------------------------------------------------------
+@pytest.mark.parametrize(
+    "name", ["adam", "adamw", "lion", "adagrad", "lamb", "sgd"]
+)
+def test_optimizer_factory_produces_updates(name):
+    import jax
+
+    cfg = OptimizerConfig(type=name, params={"lr": 1e-3, "momentum": 0.9})
+    tx = build_optimizer(cfg, build_schedule(None, {}, 1e-3))
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, state = tx.update(grads, state, params)
+    leaves = jax.tree_util.tree_leaves(updates)
+    assert all(np.isfinite(np.asarray(u)).all() for u in leaves)
+    assert any(float(jnp.sum(jnp.abs(u))) > 0 for u in leaves)
+
+
+def test_unknown_optimizer_raises():
+    with pytest.raises(KeyError):
+        build_optimizer(OptimizerConfig(type="rmsprop9000"), build_schedule(None, {}, 1e-3))
