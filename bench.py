@@ -1,0 +1,103 @@
+"""Benchmark: training throughput (tokens/sec/chip) + MFU on real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric matches BASELINE.json ("tokens/sec/chip + MFU"): value is
+tokens/sec/chip; MFU is reported alongside in the same JSON object.
+
+Model-FLOPs formula (causal decoder, fwd+bwd = 3x fwd):
+  fwd flops/token = 2*N_params + 2 * L * S * d_attnio  (causal QK^T+AV ≈
+  2 * 2 * S/2 * (H*hd) mults per token per layer)
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peaks = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    return peaks.get(gen, 197e12)
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    B, S = 8, 2048
+    model = llama(
+        "llama-tiny",
+        vocab_size=32768,
+        max_seq_len=S,
+        hidden_size=1024,
+        num_layers=24,
+        num_heads=16,
+        num_kv_heads=8,
+        intermediate_size=4096,
+    )
+    cfg = model.config
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_batch_size": B,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 0},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 1000,
+            "activation_checkpointing": {"policy": "full"},
+        },
+    )
+    data = {"input_ids": np.random.RandomState(0).randint(0, 32768, size=(B, S))}
+
+    engine.train_batch(batch=data)  # compile
+    jax.block_until_ready(engine.state.params)
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        engine.train_batch(batch=data)
+    jax.block_until_ready(engine.state.params)
+    dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_step = B * S
+    tok_per_sec = tokens_per_step / dt
+    n_params = model.num_params()
+    attn_flops_per_token = 2 * 2 * cfg.num_layers * (S / 2) * cfg.num_heads * cfg.hd
+    fwd_flops_per_token = 2 * n_params + attn_flops_per_token
+    # fwd + bwd = 3x fwd; remat (dots_saveable) adds ~0 matmul recompute here
+    model_flops = 3 * fwd_flops_per_token * tokens_per_step
+    mfu = model_flops / dt / peak_flops_per_chip()
+
+    baseline = None
+    for prior in sorted(
+        f for f in os.listdir(".") if f.startswith("BENCH_r") and f.endswith(".json")
+    ):
+        try:
+            with open(prior) as fh:
+                rec = json.load(fh)
+            baseline = rec.get("value", baseline)
+        except Exception:
+            pass
+    vs = tok_per_sec / baseline if baseline else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama-410M train tokens/sec/chip (bf16, seq2048, MFU attached)",
+                "value": round(tok_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(vs, 4),
+                "mfu": round(mfu, 4),
+                "step_time_s": round(dt, 4),
+                "params_m": round(n_params / 1e6, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

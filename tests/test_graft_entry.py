@@ -1,0 +1,21 @@
+"""Keep the driver entry points working."""
+
+import sys
+
+import jax
+
+sys.path.insert(0, ".")
+
+
+def test_entry_compiles():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    loss = jax.jit(fn)(*args)
+    assert float(loss) > 0
+
+
+def test_dryrun_multichip(devices8):
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
