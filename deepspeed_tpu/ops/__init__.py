@@ -1,2 +1,3 @@
 from .attention import attention, set_attention_impl, get_attention_impl  # noqa: F401
 from .normalization import rmsnorm  # noqa: F401
+from .pallas import flash_attention as _register_flash  # noqa: F401

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 _IMPLS: Dict[str, Callable] = {}
-_CURRENT = "xla"
+_CURRENT = "auto"  # auto => flash on TPU, xla elsewhere
 
 NEG_INF = -1e30
 
@@ -25,9 +25,17 @@ def register_attention_impl(name: str, fn: Callable) -> None:
 
 def set_attention_impl(name: str) -> None:
     global _CURRENT
-    if name not in _IMPLS:
+    if name != "auto" and name not in _IMPLS:
         raise KeyError(f"unknown attention impl {name!r}; have {sorted(_IMPLS)}")
     _CURRENT = name
+
+
+def _resolve() -> str:
+    if _CURRENT != "auto":
+        return _CURRENT
+    if jax.default_backend() == "tpu" and "flash" in _IMPLS:
+        return "flash"
+    return "xla"
 
 
 def get_attention_impl() -> str:
@@ -65,4 +73,4 @@ register_attention_impl("xla", xla_attention)
 
 
 def attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
-    return _IMPLS[_CURRENT](q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)
+    return _IMPLS[_resolve()](q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)

@@ -1,0 +1,1 @@
+"""Pallas TPU kernels (reference parity: csrc/ CUDA ops)."""

@@ -1,0 +1,115 @@
+"""Flash attention kernel vs XLA reference (fwd + grads), interpret mode on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import xla_attention
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(rng, B=2, S=256, H=4, KV=None, D=64, dtype=jnp.float32):
+    KV = KV or H
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, S, KV, D), dtype)
+    v = jax.random.normal(ks[2], (B, S, KV, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gqa_forward():
+    q, k, v = _qkv(jax.random.PRNGKey(1), H=8, KV=2)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(2), B=1, S=256, H=2, D=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=128, block_k=128) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_gqa_grads():
+    q, k, v = _qkv(jax.random.PRNGKey(3), B=1, S=128, H=4, KV=2, D=64)
+
+    g_flash = jax.grad(
+        lambda *a: jnp.sum(flash_attention(*a, causal=True, block_q=128, block_k=128) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda *a: jnp.sum(xla_attention(*a, causal=True) ** 2), argnums=(0, 1, 2)
+    )(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_unsupported_falls_back():
+    # unaligned seq length (not a multiple of 128) → fallback to XLA path
+    rng = jax.random.PRNGKey(4)
+    q = jax.random.normal(rng, (1, 100, 2, 64))
+    out = flash_attention(q, q, q, causal=True)
+    ref = xla_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_cross_length_falls_back():
+    # Sq != Sk (decode-style) must NOT silently truncate keys
+    rng = jax.random.PRNGKey(5)
+    q = jax.random.normal(rng, (1, 128, 2, 64))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (1, 256, 2, 64))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (1, 256, 2, 64))
+    out = flash_attention(q, k, v, causal=False)
+    ref = xla_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_sharded_flash_matches_reference(devices8):
+    """Under a >1-device topology, flash runs in shard_map and must agree."""
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.comm import ParallelDims
+    from deepspeed_tpu.models.sharding import use_topology
+
+    topo = comm.init_distributed(dims=ParallelDims(dp=4, tp=2))
+    q, k, v = _qkv(jax.random.PRNGKey(6), B=4, S=256, H=4, KV=2, D=64)
+    ref = xla_attention(q, k, v, causal=True)
+    with use_topology(topo):
+        out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    # grads flow through the shard_mapped kernel too
+    with use_topology(topo):
+        g = jax.jit(
+            jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v) ** 2), argnums=0)
+        )(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(xla_attention(q, k, v, causal=True) ** 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=5e-4)
+
+
+def test_registered_as_attention_impl():
+    from deepspeed_tpu.ops.attention import _IMPLS
+
+    assert "flash" in _IMPLS
