@@ -28,6 +28,14 @@ def register_comm_hook(fn: Callable) -> None:
     _COMM_HOOKS.append(fn)
 
 
+def unregister_comm_hook(fn: Callable) -> None:
+    """Remove one subscriber; other loggers' hooks stay registered."""
+    try:
+        _COMM_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
 def clear_comm_hooks() -> None:
     _COMM_HOOKS.clear()
 

@@ -1,0 +1,132 @@
+"""Experiment monitoring: CSV, TensorBoard and WandB writers behind one
+interface.
+
+Parity: deepspeed/monitor/ (monitor.py, csv_monitor.py, tb_monitor.py,
+wandb_monitor.py). Events are ``(tag, value, step)`` tuples exactly like the
+reference's ``write_events`` protocol. Backends that need missing optional
+dependencies disable themselves instead of failing (reference behavior).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import log_dist
+
+Event = Tuple[str, Any, int]
+
+
+class Monitor:
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class csv_monitor(Monitor):
+    """One CSV file per tag under ``output_path/job_name`` (reference layout)."""
+
+    def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName"):
+        self.job_dir = os.path.join(output_path or "csv_monitor", job_name)
+        os.makedirs(self.job_dir, exist_ok=True)
+        self._files: Dict[str, Any] = {}
+
+    def _writer(self, tag: str):
+        if tag not in self._files:
+            safe = tag.replace("/", "_")
+            f = open(os.path.join(self.job_dir, f"{safe}.csv"), "a", newline="")
+            self._files[tag] = (f, csv.writer(f))
+        return self._files[tag]
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for tag, value, step in event_list:
+            f, w = self._writer(tag)
+            w.writerow([step, float(value)])
+            f.flush()
+
+    def close(self):
+        for f, _ in self._files.values():
+            f.close()
+        self._files = {}
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName"):
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self.summary_writer = SummaryWriter(
+                log_dir=os.path.join(output_path or "tensorboard", job_name)
+            )
+        except Exception as e:  # tensorboard not installed → disabled
+            log_dist(f"tensorboard monitor disabled: {e}")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, float(value), step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, team=None, group=None, project=None, **kw):
+        self.run = None
+        try:
+            import wandb
+
+            self.run = wandb.init(entity=team, group=group, project=project)
+        except Exception as e:  # zero-egress image: wandb absent → disabled
+            log_dist(f"wandb monitor disabled: {e}")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.run is None:
+            return
+        import wandb
+
+        for tag, value, step in event_list:
+            wandb.log({tag: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled backend. Parity: deepspeed/monitor/monitor.py
+    (rank-0-only writes, like the reference's get_rank() guard)."""
+
+    def __init__(self, monitor_config):
+        import jax
+
+        self.monitors: List[Monitor] = []
+        if jax.process_index() != 0:
+            return
+        tb = monitor_config.tensorboard
+        if tb.get("enabled"):
+            self.monitors.append(
+                TensorBoardMonitor(
+                    tb.get("output_path", ""), tb.get("job_name", "DeepSpeedJobName")
+                )
+            )
+        wb = monitor_config.wandb
+        if wb.get("enabled"):
+            self.monitors.append(
+                WandbMonitor(
+                    team=wb.get("team"),
+                    group=wb.get("group"),
+                    project=wb.get("project"),
+                )
+            )
+        cm = monitor_config.csv_monitor
+        if cm.get("enabled"):
+            self.monitors.append(
+                csv_monitor(
+                    cm.get("output_path", ""), cm.get("job_name", "DeepSpeedJobName")
+                )
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.monitors)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for m in self.monitors:
+            m.write_events(event_list)

@@ -1,0 +1,2 @@
+from .comm_logger import CommsLogger  # noqa: F401
+from .flops_profiler import FlopsProfiler  # noqa: F401

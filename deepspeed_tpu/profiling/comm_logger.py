@@ -1,0 +1,114 @@
+"""Communication logger.
+
+Parity: deepspeed/comm/comm.py comms_logger + deepspeed/utils/comms_logging.py.
+Subscribes to the hook bus in deepspeed_tpu.comm.collectives; every collective
+issued from shard_map code (pipeline p2p, MoE all-to-all, Ulysses exchange,
+1-bit optimizer comms) is recorded at *trace time* with op name, mesh axis and
+payload bytes. XLA-inserted collectives (from sharding annotations) are not
+visible here — they are surfaced by the flops profiler's HLO pass instead.
+
+Bandwidth estimates use the reference's algbw/busbw formulas
+(deepspeed/utils/comms_logging.py get_bw): busbw applies the (n-1)/n ring
+correction for all_gather/reduce_scatter/all_reduce (2x).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from ..comm.collectives import register_comm_hook, unregister_comm_hook
+from ..utils.logging import log_dist
+
+
+def get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
+    """(algbw, busbw) in Gbps. Parity: deepspeed/utils/comms_logging.get_bw."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    tput = size_bytes * 8 / duration_s / 1e9  # Gbps
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_reduce",):
+        busbw = tput * (2 * (n - 1) / n)
+    else:  # send/recv/broadcast/ppermute/barrier
+        busbw = tput
+    return tput, busbw
+
+
+class CommsLogger:
+    """Records per-op counts/bytes; prints a summary table on demand."""
+
+    def __init__(self, config=None):
+        self.verbose = bool(getattr(config, "verbose", False))
+        self.prof_all = bool(getattr(config, "prof_all", True))
+        self.prof_ops: List[str] = list(getattr(config, "prof_ops", []) or [])
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.bytes: Dict[str, int] = defaultdict(int)
+        self.per_axis: Dict[tuple, int] = defaultdict(int)
+        self._t0 = time.time()
+        register_comm_hook(self._on_op)
+
+    def _enabled_for(self, op: str) -> bool:
+        return self.prof_all or op in self.prof_ops
+
+    @staticmethod
+    def _axis_names(axis) -> tuple:
+        if isinstance(axis, str):
+            return (axis,)
+        return tuple(str(a) for a in axis)
+
+    def _on_op(self, op: str, axis, nbytes: int) -> None:
+        if not self._enabled_for(op):
+            return
+        self.counts[op] += 1
+        self.bytes[op] += nbytes
+        self.per_axis[(op, self._axis_names(axis))] += nbytes
+        if self.verbose:
+            log_dist(f"comm: {op} axis={axis} bytes={nbytes}")
+
+    def stop(self) -> None:
+        unregister_comm_hook(self._on_op)
+
+    @property
+    def elapsed(self) -> float:
+        return time.time() - self._t0
+
+    def summary(
+        self,
+        axis_sizes: Optional[Dict[str, int]] = None,
+        duration_s: Optional[float] = None,
+    ) -> str:
+        """Render the reference's log_summary()-style table.
+
+        With ``duration_s`` (default: wall time since construction) and
+        ``axis_sizes`` (topology.sizes), adds the reference's algbw/busbw
+        columns — aggregate estimates over the whole window, since per-op
+        timing does not exist inside a fused XLA program."""
+        dur = self.elapsed if duration_s is None else duration_s
+        lines = [
+            f"{'op':<22}{'count':>8}{'total bytes':>16}{'avg bytes':>14}"
+            f"{'algbw(Gbps)':>13}{'busbw(Gbps)':>13}"
+        ]
+        for op in sorted(self.counts):
+            c, b = self.counts[op], self.bytes[op]
+            # largest participating axis-group degree for the busbw correction
+            n = 1
+            for (o, axis_names), _bytes in self.per_axis.items():
+                if o != op or not axis_sizes:
+                    continue
+                group = 1
+                for name in axis_names:
+                    group *= axis_sizes.get(name, 1)
+                n = max(n, group)
+            alg, bus = get_bw(op, b, dur, max(n, 2))
+            lines.append(
+                f"{op:<22}{c:>8}{b:>16}{b // max(c, 1):>14}{alg:>13.3f}{bus:>13.3f}"
+            )
+        return "\n".join(lines)
+
+    def log_summary(self, axis_sizes: Optional[Dict[str, int]] = None) -> None:
+        log_dist("comms summary (trace-time ops)\n" + self.summary(axis_sizes))

@@ -1,0 +1,209 @@
+"""FLOPS profiler: per-module flops/params/latency table + model summary.
+
+Parity: deepspeed/profiling/flops_profiler/profiler.py (FlopsProfiler,
+get_model_profile). The reference hooks torch modules; under XLA the program
+is one fused computation, so the TPU-native design combines:
+
+1. an *analytic* per-module breakdown from the model's TransformerConfig
+   (embedding / per-layer attention + MLP / final norm / lm_head), which is
+   exact for matmul-dominated decoders, and
+2. the *measured* XLA numbers for the whole jitted step via
+   ``Compiled.cost_analysis()`` — ground truth for total flops/bytes.
+
+Latency is attributed to modules proportionally to their flops share (an HLO
+program has no module boundaries to time individually).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+def _num(x) -> str:
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(x) < 1000:
+            return f"{x:.2f} {unit}".rstrip()
+        x /= 1000
+    return f"{x:.2f} E"
+
+
+@dataclass
+class ModuleProfile:
+    name: str
+    flops: float = 0.0
+    params: int = 0
+    latency_s: float = 0.0
+    children: List["ModuleProfile"] = field(default_factory=list)
+
+
+def transformer_module_profiles(cfg, batch: int, seq: int) -> ModuleProfile:
+    """Analytic fwd-flops breakdown for models.transformer.TransformerConfig."""
+    tokens = batch * seq
+    d, L = cfg.hidden_size, cfg.num_layers
+    H, hd, kvh = cfg.num_heads, cfg.hd, cfg.kv_heads
+    ffn, V = cfg.ffn, cfg.vocab_size
+
+    root = ModuleProfile("model", params=cfg.num_params())
+    emb = ModuleProfile("embed", flops=0.0, params=V * d)  # gather: ~0 flops
+    root.children.append(emb)
+
+    qkv_p = d * (H * hd) + 2 * d * (kvh * hd) + (H * hd) * d
+    attn_mm = 2 * tokens * qkv_p  # projections
+    attn_sc = 2 * 2 * tokens * (seq / 2) * H * hd  # causal QK^T + AV
+    n_mats = 3 if getattr(cfg, "activation", "swiglu") == "swiglu" else 2
+    expert_p = n_mats * d * ffn  # one expert's (or the dense) MLP weights
+    if getattr(cfg, "is_moe", False):
+        E, k = cfg.num_experts, cfg.moe_top_k
+        mlp_p = E * expert_p + d * E  # all experts + router
+        # each token runs top_k experts + the router projection
+        mlp_mm = 2 * tokens * (k * expert_p + d * E)
+    else:
+        mlp_p = expert_p
+        mlp_mm = 2 * tokens * expert_p
+    layers = ModuleProfile("layers", params=L * (qkv_p + mlp_p))
+    for i in range(L):
+        blk = ModuleProfile(f"layer_{i}", params=qkv_p + mlp_p)
+        blk.children = [
+            ModuleProfile("attention", flops=attn_mm + attn_sc, params=qkv_p),
+            ModuleProfile("mlp", flops=mlp_mm, params=mlp_p),
+        ]
+        blk.flops = sum(c.flops for c in blk.children)
+        layers.children.append(blk)
+    layers.flops = sum(c.flops for c in layers.children)
+    root.children.append(layers)
+
+    head = ModuleProfile("lm_head", flops=2 * tokens * d * V, params=0 if getattr(cfg, "tie_embeddings", True) else d * V)
+    root.children.append(head)
+    root.flops = sum(c.flops for c in root.children)
+    return root
+
+
+def _attribute_latency(node: ModuleProfile, total_latency: float, total_flops: float):
+    node.latency_s = total_latency * (node.flops / total_flops) if total_flops else 0.0
+    for c in node.children:
+        _attribute_latency(c, total_latency, total_flops)
+
+
+class FlopsProfiler:
+    """Parity surface: start_profile / stop_profile / print_model_profile /
+    get_total_flops / get_total_params / get_total_duration."""
+
+    def __init__(self, model=None, config=None):
+        self.model = model
+        self.config = config
+        self._t0: Optional[float] = None
+        self.total_duration = 0.0
+        self.root: Optional[ModuleProfile] = None
+        self.xla_cost: Dict[str, Any] = {}
+
+    # -- timing ----------------------------------------------------------------
+    def start_profile(self, ignore_list=None) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop_profile(self) -> None:
+        if self._t0 is not None:
+            self.total_duration = time.perf_counter() - self._t0
+            self._t0 = None
+
+    # -- accounting ------------------------------------------------------------
+    def profile_model(self, batch: int, seq: int, fwd_only: bool = True) -> ModuleProfile:
+        cfg = getattr(self.model, "config", self.model)
+        self.root = transformer_module_profiles(cfg, batch, seq)
+        if not fwd_only:  # bwd = 2x fwd for matmul-dominated graphs
+            def scale(n):
+                n.flops *= 3
+                for c in n.children:
+                    scale(c)
+            scale(self.root)
+        if self.total_duration:
+            _attribute_latency(self.root, self.total_duration, self.root.flops)
+        return self.root
+
+    def profile_compiled(self, fn, *args, **kw) -> Dict[str, Any]:
+        """XLA ground truth for any jittable fn: flops + bytes accessed."""
+        compiled = jax.jit(fn).lower(*args, **kw).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):  # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        self.xla_cost = {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        }
+        return self.xla_cost
+
+    def get_total_flops(self, as_string: bool = False):
+        total = self.root.flops if self.root else self.xla_cost.get("flops", 0.0)
+        return _num(total) + "FLOPs" if as_string else total
+
+    def get_total_params(self, as_string: bool = False):
+        total = self.root.params if self.root else 0
+        return _num(total) if as_string else total
+
+    def get_total_duration(self, as_string: bool = False):
+        return f"{self.total_duration * 1e3:.2f} ms" if as_string else self.total_duration
+
+    # -- reporting -------------------------------------------------------------
+    def print_model_profile(
+        self,
+        profile_step: int = 1,
+        module_depth: int = -1,
+        top_modules: int = 1,
+        detailed: bool = True,
+        output_file: Optional[str] = None,
+    ) -> str:
+        lines = ["-" * 72, "Flops profiler (TPU analytic + XLA cost model)", "-" * 72]
+        if self.root:
+            def render(n: ModuleProfile, depth: int):
+                if module_depth >= 0 and depth > module_depth:
+                    return
+                pct = 100 * n.flops / self.root.flops if self.root.flops else 0
+                lines.append(
+                    f"{'  ' * depth}{n.name:<24}{_num(n.flops):>12}FLOPs "
+                    f"{pct:5.1f}%  params={_num(n.params):>9}  "
+                    f"lat={n.latency_s * 1e3:8.2f}ms"
+                )
+                kids = n.children
+                if not detailed:
+                    # collapse identical layers: show layer_0 then a count
+                    if n.name == "layers" and len(n.children) > 1:
+                        kids = kids[:1]
+                        lines.append(
+                            f"{'  ' * (depth + 1)}... x{len(n.children)} layers"
+                        )
+                    elif depth >= 1:
+                        kids = kids[:top_modules]
+                for c in kids:
+                    render(c, depth + 1)
+            render(self.root, 0)
+        if self.xla_cost:
+            lines.append(
+                f"XLA cost model: {_num(self.xla_cost['flops'])}FLOPs, "
+                f"{_num(self.xla_cost['bytes_accessed'])}B accessed"
+            )
+        if self.total_duration:
+            lines.append(f"step latency: {self.total_duration * 1e3:.2f} ms")
+        out = "\n".join(lines)
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(out + "\n")
+        else:
+            log_dist(out)
+        return out
+
+
+def get_model_profile(model, batch: int, seq: int, as_string: bool = False,
+                      fwd_only: bool = True):
+    """Parity: flops_profiler.get_model_profile → (flops, macs, params)."""
+    prof = FlopsProfiler(model)
+    root = prof.profile_model(batch, seq, fwd_only=fwd_only)
+    flops, macs, params = root.flops, root.flops / 2, root.params
+    if as_string:
+        return _num(flops) + "FLOPs", _num(macs) + "MACs", _num(params)
+    return flops, macs, params

@@ -517,4 +517,16 @@ class TpuEngine:
     def load_checkpoint(self, load_dir, tag=None, strict=True):
         from .checkpointing import load_checkpoint as _load
 
-        return _load(self, load_dir, tag=tag)
+        return _load(self, load_dir, tag=tag, strict=strict)
+
+    def destroy(self):
+        """Parity: DeepSpeedEngine.destroy — release global hooks/writers so
+        engines created in a loop don't accumulate loggers."""
+        if self.comm_logger is not None:
+            self.comm_logger.stop()
+            self.comm_logger = None
+        if self.monitor is not None:
+            for m in self.monitor.monitors:
+                if hasattr(m, "close"):
+                    m.close()
+            self.monitor = None
