@@ -50,11 +50,33 @@ def _filter_spec(spec: PartitionSpec, topo: MeshTopology) -> PartitionSpec:
 
 
 def constrain(x, *spec_entries):
-    """Constrain activation sharding; no-op outside an installed topology."""
+    """Constrain activation sharding; no-op outside an installed topology.
+
+    Inside a partially-manual ``shard_map`` (the pipeline schedule: pp is
+    Manual, the rest Auto), constraints must be expressed on the context's
+    abstract mesh with Manual axes dropped from the spec."""
     topo = current_topology()
     if topo is None or topo.world_size == 1:
         return x
     spec = _filter_spec(PartitionSpec(*spec_entries), topo)
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and not am.empty:
+        manual = {
+            name
+            for name, t in zip(am.axis_names, am.axis_types)
+            if t == jax.sharding.AxisType.Manual
+        }
+        if manual:
+            def drop(entry):
+                if entry is None:
+                    return None
+                if isinstance(entry, (tuple, list)):
+                    kept = tuple(a for a in entry if a not in manual)
+                    return kept if kept else None
+                return None if entry in manual else entry
+
+            spec = PartitionSpec(*(drop(e) for e in spec))
+            return jax.lax.with_sharding_constraint(x, NamedSharding(am, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
 
 

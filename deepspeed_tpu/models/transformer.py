@@ -314,6 +314,50 @@ def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
 # -----------------------------------------------------------------------------
 # forward / loss
 # -----------------------------------------------------------------------------
+def embed_tokens(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
+                 positions: jax.Array, dtype) -> jax.Array:
+    """Token (+pos) embedding; works for [B,S] and [M,mb,S] id shapes."""
+    cast = lambda t: jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
+    )
+    x = cast(params["embed"]["tok"])[input_ids]
+    if cfg.pos_embedding == "learned":
+        x = x + cast(params["embed"]["pos"])[positions]
+    if cfg.embed_norm:
+        x = _norm(cfg, cast(params["embed_norm"]), x)
+    lead = (None,) * (input_ids.ndim - 2)
+    return constrain(x, *lead, ("dp", "fsdp"), "sp", None)
+
+
+def lm_head_logits(cfg: TransformerConfig, params: Params, y: jax.Array) -> jax.Array:
+    """Final projection → fp32 logits [..., S, V] (vocab tp-sharded)."""
+    head = params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "...sd,dv->...sv", y.astype(jnp.float32), head.astype(jnp.float32)
+    )
+    lead = (None,) * (y.ndim - 3)
+    return constrain(logits, *lead, ("dp", "fsdp"), "sp", "tp")
+
+
+def masked_ce(logits: jax.Array, labels: jax.Array, num_mb_dims: int = 0):
+    """(ce, total_valid_tokens); labels < 0 ignored (HF -100 style).
+
+    num_mb_dims > 0: the first ``num_mb_dims`` dims index microbatches; each
+    microbatch is normalized by its own token count and the results averaged
+    — matching the engine's per-microbatch accumulation semantics."""
+    mask = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    if num_mb_dims:
+        red = tuple(range(num_mb_dims, labels.ndim))
+        per_mb = nll.sum(red) / jnp.maximum(mask.sum(red), 1.0)
+        return jnp.mean(per_mb), jnp.maximum(mask.sum(), 1.0)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return nll.sum() / denom, denom
+
+
 def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
           dtype=jnp.bfloat16, train: bool = False, rng: Optional[jax.Array] = None,
           positions: Optional[jax.Array] = None, segment_ids=None,
@@ -325,21 +369,12 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
     cast = lambda t: jax.tree.map(
         lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
     )
-    x = cast(params["embed"]["tok"])[input_ids]
-    if cfg.pos_embedding == "learned":
-        x = x + cast(params["embed"]["pos"])[positions]
-    if cfg.embed_norm:
-        x = _norm(cfg, cast(params["embed_norm"]), x)
-    x = constrain(x, ("dp", "fsdp"), "sp", None)
-
+    x = embed_tokens(cfg, params, input_ids, positions, dtype)
     x, aux = apply_layer_stack(
         cfg, cast(params["layers"]), x, positions, segment_ids, rng, train, remat_policy
     )
     x = _norm(cfg, cast(params["final_norm"]), x)
-    head = params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
-    logits = constrain(logits, ("dp", "fsdp"), "sp", "tp")
-    return logits, aux
+    return lm_head_logits(cfg, params, x), aux
 
 
 def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array], *,
@@ -351,14 +386,7 @@ def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
         segment_ids=batch.get("segment_ids"), positions=batch.get("positions"),
         remat_policy=remat_policy,
     )
-    labels = batch["labels"]
-    mask = (labels >= 0).astype(jnp.float32)
-    safe_labels = jnp.maximum(labels, 0)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
-    denom = jnp.maximum(mask.sum(), 1.0)
-    ce = nll.sum() / denom
+    ce, denom = masked_ce(logits, batch["labels"])
     total = ce + cfg.moe_aux_loss_coef * aux if cfg.is_moe else ce
     return total, {"lm_loss": ce, "moe_aux_loss": aux, "tokens": denom}
 

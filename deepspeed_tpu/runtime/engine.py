@@ -251,11 +251,11 @@ class TpuEngine:
         )
         return loss * scale, (loss, metrics)
 
-    def _train_step(self, params, opt_state, loss_scale, step, batch, rng):
-        cfg = self.config
-        accum = cfg.gradient_accumulation_steps
-        scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
-
+    def _compute_grads(self, params, batch, rng, scale):
+        """(grads fp32 mean-over-microbatches, mean loss). ``batch`` has a
+        leading grad-accum dim. Overridden by PipelineEngine (the pipeline
+        schedule consumes all microbatches in one pipelined pass)."""
+        accum = self.config.gradient_accumulation_steps
         grad_fn = jax.value_and_grad(self._loss_for, has_aux=True)
         zero_grads = jax.tree.map(
             lambda x: jnp.zeros(x.shape, jnp.float32), params
@@ -274,7 +274,12 @@ class TpuEngine:
         )
         inv = 1.0 / (accum * scale)
         grads = jax.tree.map(lambda g: g * inv, grads)
-        loss = loss_sum / accum
+        return grads, loss_sum / accum
+
+    def _train_step(self, params, opt_state, loss_scale, step, batch, rng):
+        cfg = self.config
+        scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
+        grads, loss = self._compute_grads(params, batch, rng, scale)
 
         # ZeRO>=2: materialize grads sharded (psum → reduce-scatter)
         if cfg.zero_config.stage >= 2 and self.topology.world_size > 1:

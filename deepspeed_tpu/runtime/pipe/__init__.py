@@ -1,0 +1,2 @@
+from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .schedule import pipelined_stack  # noqa: F401

@@ -1,0 +1,61 @@
+"""Pipeline engine.
+
+Parity: deepspeed/runtime/pipe/engine.py (PipelineEngine). The reference
+subclasses DeepSpeedEngine and replaces train_batch with an instruction-list
+schedule executor; here the only override is gradient computation — the
+microbatch stream goes through the shard_map pipeline (schedule.py) in one
+jitted pass, and everything else (loss scaling, clipping, optimizer, ZeRO
+shardings, checkpointing) is inherited unchanged.
+
+Constraint carried over from the reference: train_batch()'s gradient
+accumulation count is the pipeline microbatch count (the reference asserts
+the same), and ZeRO-2/3 don't compose with pp (grads must persist across
+the schedule) — config validation enforces it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..engine import TpuEngine
+from .module import PipelineModule
+
+
+class PipelineEngine(TpuEngine):
+    def __init__(self, model, config, topology, **kw):
+        if not getattr(model, "is_pipeline_module", False):
+            model = PipelineModule(
+                model=model,
+                num_stages=config.pipeline.stages,
+                partition_method=config.pipeline.partition_method,
+                activation_checkpoint_interval=(
+                    config.pipeline.activation_checkpoint_interval
+                ),
+            )
+        if topology.pp_size > 1 and config.gradient_accumulation_steps < topology.pp_size:
+            from ...utils.logging import log_dist
+
+            log_dist(
+                f"warning: grad_accum ({config.gradient_accumulation_steps}) < "
+                f"pipeline stages ({topology.pp_size}); bubble fraction is "
+                f"{(topology.pp_size - 1) / (config.gradient_accumulation_steps + topology.pp_size - 1):.0%}"
+            )
+        super().__init__(model=model, config=config, topology=topology, **kw)
+
+    def _compute_grads(self, params, batch, rng, scale):
+        def scaled_loss(p):
+            loss, _metrics = self.model.pipeline_loss(
+                p,
+                batch,
+                topology=self.topology,
+                dtype=self.compute_dtype,
+                train=True,
+                rng=rng,
+                remat_policy=self.remat_policy,
+            )
+            return loss * scale, loss
+
+        (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        inv = 1.0 / scale
+        grads = jax.tree.map(lambda g: g.astype(jax.numpy.float32) * inv, grads)
+        return grads, loss
