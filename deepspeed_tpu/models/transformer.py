@@ -29,7 +29,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .sharding import constrain
+from .sharding import constrain, current_topology
 
 Params = Dict[str, Any]
 
@@ -228,9 +228,6 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
         v = v + p["bv"].reshape(1, 1, nkv, hd)
     if cfg.pos_embedding == "rope":
         q, k = _rope(q, k, positions, cfg.rope_theta)
-    q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
-    k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
-    v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
 
     bias = None
     if cfg.pos_embedding == "alibi":
@@ -238,7 +235,17 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
         rel = positions[:, None, :].astype(jnp.float32) - positions[:, :, None].astype(jnp.float32)
         bias = slopes[None, :, None, None] * (-jnp.abs(rel))[:, None, :, :]  # [B,H,S,S]
 
-    out = attn_op(q, k, v, causal=True, bias=bias, segment_ids=segment_ids)  # [B,S,H,hd]
+    topo = current_topology()
+    if topo is not None and topo.sp_size > 1:
+        # sequence parallel: Ulysses all-to-all or KV ring (parallel/sequence.py)
+        from ..parallel.sequence import sp_attention
+
+        out = sp_attention(q, k, v, causal=True, bias=bias, segment_ids=segment_ids)
+    else:
+        q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
+        k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
+        v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
+        out = attn_op(q, k, v, causal=True, bias=bias, segment_ids=segment_ids)  # [B,S,H,hd]
     out = out.reshape(B, S, nh * hd)
     out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
     if cfg.use_bias:

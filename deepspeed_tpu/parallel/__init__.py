@@ -1,0 +1,6 @@
+from .sequence import (  # noqa: F401
+    ring_attention,
+    set_sp_mode,
+    sp_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,202 @@
+"""Sequence/context parallelism: DS-Ulysses and ring attention.
+
+Parity: deepspeed/sequence/layer.py (DistributedAttention — the DS-Ulysses
+all-to-all head<->sequence exchange) and the reference's long-context story.
+TPU-native design:
+
+- **Ulysses** is pure sharding arithmetic: activations arrive sequence-
+  sharded over the ``sp`` mesh axis; constraining q/k/v to *head*-sharded
+  (full sequence per device) makes XLA insert exactly the two all-to-alls
+  the reference codes by hand, and any attention impl (XLA softmax or the
+  Pallas flash kernel) runs unmodified on the full sequence. The output
+  constraint swaps back to sequence sharding.
+- **Ring attention** keeps q/k/v sequence-sharded and rotates KV blocks
+  around the sp ring with ``ppermute`` (ICI neighbor hops), accumulating
+  flash-style online softmax in fp32. Peak memory per chip is O(S/sp),
+  enabling sequences that do not fit any single chip — the reference's
+  blocked-attention / Ulysses-offload regime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models.sharding import constrain, current_topology
+
+_SP_MODE = "ulysses"  # process default; engines attach sp_mode to their topology
+
+_VALID_MODES = ("ulysses", "ring")
+
+
+def set_sp_mode(mode: str) -> None:
+    """Set the process-wide default. Engines override per-topology
+    (topology.sp_mode), so two engines with different modes don't fight."""
+    global _SP_MODE
+    if mode not in _VALID_MODES:
+        raise ValueError(f"sequence_parallel mode {mode!r} (ulysses|ring)")
+    _SP_MODE = mode
+
+
+def get_sp_mode() -> str:
+    topo = current_topology()
+    mode = getattr(topo, "sp_mode", None) if topo is not None else None
+    return mode or _SP_MODE
+
+
+def _in_manual_context() -> bool:
+    am = jax.sharding.get_abstract_mesh()
+    return (
+        am is not None
+        and not am.empty
+        and any(t == jax.sharding.AxisType.Manual for t in am.axis_types)
+    )
+
+
+def ulysses_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+    """DS-Ulysses: all-to-all seq->head, full-seq attention, all-to-all back.
+
+    Parity: deepspeed/sequence/layer.py DistributedAttention.forward — the
+    reference's explicit ``_SeqAllToAll`` pair becomes two sharding
+    constraints; XLA's SPMD partitioner emits the all-to-alls over ICI.
+    """
+    from ..ops.attention import attention as attn_op
+
+    # heads over (tp, sp): each device sees H/(tp*sp) heads, full sequence
+    q = constrain(q, ("dp", "fsdp"), None, ("tp", "sp"), None)
+    k = constrain(k, ("dp", "fsdp"), None, ("tp", "sp"), None)
+    v = constrain(v, ("dp", "fsdp"), None, ("tp", "sp"), None)
+    out = attn_op(q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)
+    # back to sequence sharding for the rest of the block
+    return constrain(out, ("dp", "fsdp"), "sp", "tp", None)
+
+
+def _ring_attention_local(q, k, v, seg_q, seg_k, *, causal: bool, axis: str):
+    """Online-softmax ring pass over the ``axis`` ring (inside shard_map).
+
+    q/k/v: local blocks [B, S_loc, H|KV, hd]; positions are globalized from
+    the ring index, so causal masking is exact across blocks.
+    """
+    sp = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    B, Sq, H, hd = q.shape
+    KV = k.shape[2]
+    reps = H // KV  # GQA: expand per-step at compute time, so the ring
+    # carries only the KV-head payload (H/KV x less ICI traffic)
+    qf = q.astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    qpos = i * Sq + jnp.arange(Sq)  # global positions of local queries
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    acc0 = jnp.zeros((B, Sq, H, hd), jnp.float32)
+
+    def step(carry, s):
+        m, l, acc, kb, vb, segb = carry
+        blk = (i - s) % sp  # whose KV block we hold at step s
+        kpos = blk * Sq + jnp.arange(Sq)
+        ke = jnp.repeat(kb, reps, axis=2) if reps > 1 else kb
+        ve = jnp.repeat(vb, reps, axis=2) if reps > 1 else vb
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, ke.astype(jnp.float32)) * scale
+        valid = jnp.ones((B, 1, Sq, Sq), jnp.bool_)
+        if causal:
+            valid = valid & (kpos[None, None, None, :] <= qpos[None, None, :, None])
+        if segb is not None:
+            same = seg_q[:, None, :, None] == segb[:, None, None, :]
+            valid = valid & same
+        logits = jnp.where(valid, logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(-1))
+        # fully-masked-so-far rows keep m=-inf; guard the exp against inf-inf
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(logits - m_safe[..., None]) * valid  # [B,H,Sq,Sk]
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * corr + p.sum(-1)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, ve.astype(jnp.float32)
+        )
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        if segb is not None:
+            segb = lax.ppermute(segb, axis, perm)
+        return (m_new, l, acc, kb, vb, segb), None
+
+    (m, l, acc, _, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v, seg_k), jnp.arange(sp)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal=True, segment_ids=None,
+                   topo=None, axis: str = "sp"):
+    """Ring attention over the sp mesh axis (q/k/v arrive seq-sharded).
+
+    q: [B, S, H, hd] global. ALiBi bias is not supported on the ring path
+    (use ulysses); RoPE is already applied upstream with global positions.
+    """
+    topo = topo or current_topology()
+    if topo is None or topo.sp_size == 1:
+        from ..ops.attention import attention as attn_op
+
+        return attn_op(q, k, v, causal=causal, segment_ids=segment_ids)
+
+    has_seg = segment_ids is not None
+    seg = (
+        segment_ids
+        if has_seg
+        else jnp.zeros((q.shape[0], q.shape[1]), jnp.int32)
+    )
+
+    def body(ql, kl, vl, segl):
+        return _ring_attention_local(
+            ql, kl, vl, segl, segl if has_seg else None, causal=causal, axis=axis
+        )
+
+    run = jax.shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=(
+            P(None, axis, None, None),
+            P(None, axis, None, None),
+            P(None, axis, None, None),
+            P(None, axis),
+        ),
+        out_specs=P(None, axis, None, None),
+        axis_names={axis},
+        check_vma=False,
+    )
+    return run(q, k, v, seg)
+
+
+_warned_fallback = set()
+
+
+def sp_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+    """Dispatch by configured SP mode; called from the model's attention
+    when the installed topology has sp_size > 1."""
+    mode = get_sp_mode()
+    if mode == "ring":
+        if bias is None and not _in_manual_context():
+            return ring_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        reason = (
+            "attention bias (ALiBi) is unsupported on the ring path"
+            if bias is not None
+            else "ring cannot nest inside the pipeline's manual shard_map"
+        )
+        if reason not in _warned_fallback:  # memory profile changes: say so
+            from ..utils.logging import log_dist
+
+            log_dist(
+                f"warning: sequence_parallel mode 'ring' falling back to "
+                f"ulysses: {reason} (full sequence will be materialized per "
+                f"chip inside attention)"
+            )
+            _warned_fallback.add(reason)
+    return ulysses_attention(
+        q, k, v, causal=causal, bias=bias, segment_ids=segment_ids
+    )

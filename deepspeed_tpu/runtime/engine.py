@@ -166,6 +166,9 @@ class TpuEngine:
         self.fp16_enabled = config.fp16.enabled
         self.compute_dtype = config.compute_dtype
         self.remat_policy = config.activation_checkpointing.policy
+        if topology.sp_size > 1:
+            # per-topology, so two engines with different modes don't fight
+            topology.sp_mode = config.sequence_parallel.mode
 
         # ---- schedule + optimizer ------------------------------------------
         self.lr_schedule = build_schedule(

@@ -153,7 +153,8 @@ class PipelineModule:
         # 'type:' patterns have no meaning for stacked params.
         method = partition_method.lower()
         if method in ("parameters", "uniform"):
-            self.parts = partition_balanced([1.0] * L, num_stages)
+            # homogeneous stacked blocks: balanced == uniform
+            self.parts = partition_uniform(L, num_stages)
         else:
             raise ValueError(
                 f"partition_method {partition_method!r} not supported "
@@ -208,8 +209,8 @@ class PipelineModule:
         # XLA CPU crashes ("Invalid binary instruction opcode copy" in
         # AllReducePromotion) on bf16 all-reduce inside a partial-manual
         # shard_map region; CPU meshes (tests, driver dryrun) compute the
-        # pipelined region in fp32. TPU keeps the configured dtype.
-        if topology.mesh.devices.flat[0].platform != "tpu":
+        # pipelined region in fp32. TPU/GPU keep the configured dtype.
+        if topology.mesh.devices.flat[0].platform == "cpu":
             dtype = jnp.float32
         if remat_policy in (None, "none") and self.activation_checkpoint_interval:
             remat_policy = "full"  # ds parity: interval>0 turns on remat
