@@ -442,6 +442,11 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 "ZeRO stages 2/3 are incompatible with pipeline parallelism (reference parity)"
             )
+        if self.sequence_parallel.mode not in ("ulysses", "ring"):
+            raise DeepSpeedConfigError(
+                f"sequence_parallel.mode must be 'ulysses' or 'ring', got "
+                f"{self.sequence_parallel.mode!r}"
+            )
 
     # dtype policy ------------------------------------------------------------
     @property

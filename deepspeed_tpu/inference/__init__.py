@@ -1,0 +1,1 @@
+from .engine import InferenceEngine, init_inference  # noqa: F401

@@ -1,0 +1,259 @@
+"""Inference engine: TP-sharded serving with a static KV cache.
+
+Parity: deepspeed/inference/engine.py (InferenceEngine) + deepspeed
+__init__.init_inference. The reference swaps torch modules for fused CUDA
+blocks ("kernel injection") and walks an eager token loop; TPU-native:
+
+- one jitted prefill (full-prompt forward that fills the cache) and one
+  jitted ``lax.while_loop`` decode program — every step identical shapes,
+  compiled once, KV cache donated through the loop;
+- tensor parallelism is the model's partition_specs placed on the mesh
+  (weights sharded column/row over tp); XLA inserts the serving
+  collectives;
+- ``replace_with_kernel_inject`` maps to selecting the Pallas flash
+  attention path for prefill (the decode matvec is already MXU-shaped);
+- ``dtype=int8`` / quantize flags use ops/quantizer.py weight-only block
+  quantization (dequant fused into the consuming matmul by XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import contextlib
+
+from ..comm.topology import MeshTopology, ParallelDims
+
+
+def _nullctx():
+    return contextlib.nullcontext()
+from ..models.decoding import forward_with_cache, init_cache
+from ..models.sharding import use_topology
+from ..ops.quantizer import quantize_dequantize
+from ..utils.logging import log_dist
+
+
+def init_inference(
+    model,
+    tensor_parallel: Optional[Dict[str, Any]] = None,
+    tp_size: int = 1,
+    dtype=jnp.bfloat16,
+    replace_with_kernel_inject: bool = False,
+    quantize_bits: Optional[int] = None,
+    max_tokens: int = 1024,
+    checkpoint=None,
+    topology: Optional[MeshTopology] = None,
+    params=None,
+    rng: Optional[jax.Array] = None,
+    **kwargs,
+) -> "InferenceEngine":
+    """Parity: deepspeed.init_inference(model, tp_size, dtype, ...)."""
+    if tensor_parallel:
+        tp_size = tensor_parallel.get("tp_size", tp_size)
+    if dtype in ("int8", jnp.int8):
+        dtype = jnp.bfloat16
+        quantize_bits = quantize_bits or 8
+    if topology is None:
+        n = tp_size if tp_size > 1 else 1
+        topology = MeshTopology(
+            dims=ParallelDims(tp=tp_size), devices=jax.devices()[:n]
+        )
+    return InferenceEngine(
+        model,
+        topology=topology,
+        dtype=dtype,
+        kernel_inject=replace_with_kernel_inject,
+        quantize_bits=quantize_bits,
+        max_tokens=max_tokens,
+        params=params,
+        rng=rng,
+    )
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model,
+        topology: MeshTopology,
+        dtype=jnp.bfloat16,
+        kernel_inject: bool = False,
+        quantize_bits: Optional[int] = None,
+        max_tokens: int = 1024,
+        params=None,
+        rng: Optional[jax.Array] = None,
+    ):
+        self.model = model
+        self.config = model.config
+        self.topology = topology
+        self.dtype = dtype
+        self.max_tokens = min(max_tokens, self.config.max_seq_len)
+        self.kernel_inject = kernel_inject
+        # "kernel injection" parity: this engine's traces prefer the Pallas
+        # flash prefill ("auto" resolves to flash on TPU); scoped via a
+        # context manager so other engines' pinned impls are untouched
+        from ..ops.attention import attention_impl
+
+        self._impl_ctx = (
+            (lambda: attention_impl("auto")) if kernel_inject
+            else (lambda: _nullctx())
+        )
+
+        tp_specs = (
+            model.partition_specs(topology)
+            if hasattr(model, "partition_specs")
+            else None
+        )
+        if params is None:
+            params = model.init(
+                rng if rng is not None else jax.random.PRNGKey(0), dtype=dtype
+            )
+        cast = lambda a: (
+            a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
+        )
+        params = jax.tree.map(cast, params)
+        if quantize_bits:
+            params = self._quantize_weights(params, quantize_bits)
+        if tp_specs is not None and topology.world_size > 1:
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(topology.mesh, s),
+                tp_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            params = jax.device_put(params, shardings)
+        self.params = params
+        self._decode_fns: Dict[int, Any] = {}
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        log_dist(
+            f"InferenceEngine: {n_params / 1e6:.1f}M params, dtype="
+            f"{jnp.dtype(dtype).name}, tp={topology.tp_size}, "
+            f"quant={quantize_bits or 'off'}, kernel_inject={kernel_inject}"
+        )
+
+    def _quantize_weights(self, params, bits: int):
+        """Weight-only block quantization of the big matmul weights."""
+        big = {"wq", "wk", "wv", "wo", "wi", "wg"}
+
+        def q(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in big and leaf.ndim >= 2:
+                return quantize_dequantize(leaf, block=128, bits=bits)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(q, params)
+
+    # -------------------------------------------------------------- forward
+    def forward(self, input_ids):
+        """Plain logits forward (no cache) — reference engine __call__."""
+        if not hasattr(self, "_jit_forward"):  # jit once, not per call
+            self._jit_forward = jax.jit(
+                lambda p, ids: self.model.apply(p, ids, dtype=self.dtype)
+            )
+        with use_topology(self.topology), self._impl_ctx():
+            logits, _ = self._jit_forward(self.params, jnp.asarray(input_ids))
+        return logits
+
+    __call__ = forward
+
+    # ------------------------------------------------------------- generate
+    def _build_decode(self, B: int, prompt_len: int, total_len: int):
+        cfg = self.config
+
+        def prefill(params, tokens_buf):
+            cache = init_cache(cfg, B, total_len, self.dtype)
+            prompt = tokens_buf[:, :prompt_len]
+            logits, cache = forward_with_cache(
+                cfg, params, prompt, cache, 0, dtype=self.dtype
+            )
+            return logits[:, -1], cache
+
+        def sample(logits, key, temperature, top_k):
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            greedy = jnp.argmax(logits, axis=-1)
+            sampled = jax.random.categorical(key, logits, axis=-1)
+            return jnp.where(temperature == 0.0, greedy, sampled)
+
+        def generate(params, tokens_buf, rng, temperature, top_k, eos_id):
+            last_logits, cache = prefill(params, tokens_buf)
+            key, rng = jax.random.split(rng)
+            nxt = sample(last_logits, key, temperature, top_k)
+            tokens_buf = lax.dynamic_update_slice(
+                tokens_buf, nxt[:, None], (0, prompt_len)
+            )
+            done = nxt == eos_id
+
+            def cond(state):
+                _, _, pos, _, done = state
+                return (pos < total_len - 1) & ~jnp.all(done)
+
+            def body(state):
+                tokens_buf, cache, pos, rng, done = state
+                tok = lax.dynamic_slice(tokens_buf, (0, pos), (B, 1))
+                logits, cache = forward_with_cache(
+                    self.config, params, tok, cache, pos, dtype=self.dtype
+                )
+                key, rng = jax.random.split(rng)
+                nxt = sample(logits[:, -1], key, temperature, top_k)
+                nxt = jnp.where(done, jnp.full_like(nxt, eos_id), nxt)
+                tokens_buf = lax.dynamic_update_slice(
+                    tokens_buf, nxt[:, None], (0, pos + 1)
+                )
+                done = done | (nxt == eos_id)
+                return (tokens_buf, cache, pos + 1, rng, done)
+
+            tokens_buf, _, _, _, _ = lax.while_loop(
+                cond, body, (tokens_buf, cache, jnp.asarray(prompt_len), rng, done)
+            )
+            return tokens_buf
+
+        return jax.jit(generate, static_argnums=(4,))  # top_k gates a sort
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        eos_token_id: int = -1,
+        rng: Optional[jax.Array] = None,
+    ):
+        """Greedy (temperature=0) or top-k sampled decoding.
+
+        Returns [B, prompt + max_new_tokens] token ids (eos-padded).
+        """
+        ids = np.asarray(input_ids)
+        B, prompt_len = ids.shape
+        if prompt_len >= self.max_tokens:
+            raise ValueError(
+                f"prompt length {prompt_len} leaves no room to generate under "
+                f"max_tokens={self.max_tokens} (model max_seq_len="
+                f"{self.config.max_seq_len}); truncate the prompt or raise "
+                f"max_tokens"
+            )
+        total_len = min(prompt_len + max_new_tokens, self.max_tokens)
+        key = (B, prompt_len, total_len)
+        if key not in self._decode_fns:
+            self._decode_fns[key] = self._build_decode(B, prompt_len, total_len)
+        buf = np.full((B, total_len), eos_token_id if eos_token_id >= 0 else 0,
+                      dtype=np.int32)
+        buf[:, :prompt_len] = ids
+        with use_topology(self.topology), self._impl_ctx():
+            out = self._decode_fns[key](
+                self.params,
+                jnp.asarray(buf),
+                rng if rng is not None else jax.random.PRNGKey(0),
+                jnp.asarray(temperature, jnp.float32),
+                top_k,
+                eos_token_id,
+            )
+        return np.asarray(out)

@@ -1,0 +1,144 @@
+"""KV-cache decoding forward passes for the transformer core.
+
+Parity: deepspeed/inference/engine.py + csrc/transformer/inference (the
+fused decode path with static KV cache). TPU-native: the cache is a static
+ring buffer [L, B, S_max, KV, hd] so every decode step is the same compiled
+program (no dynamic shapes); the token loop is a ``lax.while_loop`` in
+inference/engine.py.
+
+Sharding: caches inherit the model's TP layout (KV heads over tp, batch over
+dp) via constrain; decode attention is a [B,1,H,hd] x [B,S,KV,hd] contraction
+that XLA maps onto the MXU as a batched matvec.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sharding import constrain
+from .transformer import (
+    Params,
+    TransformerConfig,
+    _mlp,
+    _norm,
+    _rope,
+    alibi_slopes,
+    lm_head_logits,
+)
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> Cache:
+    """Static KV ring buffer for all layers."""
+    shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.hd)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+    }
+
+
+def _qkv(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array):
+    B, S, _ = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
+    q = jnp.einsum("bsd,dh->bsh", x, p["wq"]).reshape(B, S, nh, hd)
+    k = jnp.einsum("bsd,dh->bsh", x, p["wk"]).reshape(B, S, nkv, hd)
+    v = jnp.einsum("bsd,dh->bsh", x, p["wv"]).reshape(B, S, nkv, hd)
+    if cfg.use_bias:
+        q = q + p["bq"].reshape(1, 1, nh, hd)
+        k = k + p["bk"].reshape(1, 1, nkv, hd)
+        v = v + p["bv"].reshape(1, 1, nkv, hd)
+    if cfg.pos_embedding == "rope":
+        q, k = _rope(q, k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
+                      positions: jax.Array, k_cache: jax.Array,
+                      v_cache: jax.Array, cache_len) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Attend new tokens (x, [B,S,D]) against cache[:cache_len] + themselves.
+
+    Returns (out, new_k_cache, new_v_cache). Works for prefill (S=prompt,
+    cache_len=0) and decode (S=1, cache_len=pos).
+    """
+    B, S, _ = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
+    S_max = k_cache.shape[1]
+    q, k, v = _qkv(cfg, p, x, positions)
+
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
+    )
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
+    )
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    if nkv != nh:
+        kf = jnp.repeat(kf, nh // nkv, axis=2)
+        vf = jnp.repeat(vf, nh // nkv, axis=2)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kf) * scale
+    kpos = jnp.arange(S_max)[None, None, None, :]
+    qpos = (cache_len + jnp.arange(S))[None, None, :, None]
+    if cfg.pos_embedding == "alibi":
+        slopes = jnp.asarray(alibi_slopes(nh))
+        logits = logits + slopes[None, :, None, None] * (
+            -jnp.abs(kpos.astype(jnp.float32) - qpos.astype(jnp.float32))
+        )
+    logits = jnp.where(kpos <= qpos, logits, -1e30)  # causal + cache bound
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
+    out = out.reshape(B, S, nh * hd)
+    out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+    if cfg.use_bias:
+        out = out + p["bo"]
+    return out, k_cache, v_cache
+
+
+def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
+                       cache: Cache, cache_len, *,
+                       dtype=jnp.bfloat16) -> Tuple[jax.Array, Cache]:
+    """Run new tokens through all layers against the cache.
+
+    input_ids: [B, S] (prefill) or [B, 1] (decode). cache_len: tokens already
+    cached. Returns (fp32 logits [B, S, V], updated cache).
+    """
+    B, S = input_ids.shape
+    cast = lambda t: jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
+    )
+    positions = cache_len + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = cast(params["embed"]["tok"])[input_ids]
+    if cfg.pos_embedding == "learned":
+        x = x + cast(params["embed"]["pos"])[positions]
+    if cfg.embed_norm:
+        x = _norm(cfg, cast(params["embed_norm"]), x)
+    x = constrain(x, ("dp", "fsdp"), None, None)
+
+    layers = cast(params["layers"])
+
+    def body(carry, scanned):
+        h = carry
+        layer, kc, vc = scanned
+        a, kc, vc = _cached_attention(
+            cfg, layer["attn"], _norm(cfg, layer["ln1"], h), positions, kc, vc,
+            cache_len,
+        )
+        h = h + a
+        normed = _norm(cfg, layer["ln2"], h)
+        m, _aux = _mlp(cfg, layer["mlp"], normed, rng=None, train=False)
+        h = h + m
+        h = constrain(h, ("dp", "fsdp"), None, None)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(body, x, (layers, cache["k"], cache["v"]))
+    x = _norm(cfg, cast(params["final_norm"]), x)
+    logits = lm_head_logits(cfg, params, x)
+    return logits, {"k": k_new, "v": v_new}

@@ -30,9 +30,29 @@ def set_attention_impl(name: str) -> None:
     _CURRENT = name
 
 
+_override_stack: list = []
+
+
+class attention_impl:
+    """Scoped impl override (no global mutation): with attention_impl("flash")."""
+
+    def __init__(self, name: str):
+        if name != "auto" and name not in _IMPLS:
+            raise KeyError(f"unknown attention impl {name!r}; have {sorted(_IMPLS)}")
+        self.name = name
+
+    def __enter__(self):
+        _override_stack.append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        _override_stack.pop()
+
+
 def _resolve() -> str:
-    if _CURRENT != "auto":
-        return _CURRENT
+    cur = _override_stack[-1] if _override_stack else _CURRENT
+    if cur != "auto":
+        return cur
     if jax.default_backend() == "tpu" and "flash" in _IMPLS:
         return "flash"
     return "xla"

@@ -1,0 +1,65 @@
+"""Symmetric int8/int4 block quantization.
+
+Parity: csrc/quantization (the reference's quantizer kernels) +
+deepspeed/compression weight quantization. XLA fuses the dequant multiply
+into the consuming matmul, so the Python-level q/dq here compiles to the
+same fused kernel the reference hand-writes; a Pallas variant is only
+needed for the quantized-collective path (ZeRO++), which quantizes on the
+wire.
+
+Layout: weights are quantized over blocks of the *first* dim (the
+contraction dim in this codebase's ``d,dh->h`` einsums), one fp scale per
+(block, column).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    qdata: jax.Array  # int8 [..., G, B, N] packed view of the original
+    scale: jax.Array  # fp32 [..., G, 1, N]
+    shape: Tuple[int, ...]  # original shape
+    bits: int
+
+
+def quantize_blockwise(w: jax.Array, block: int = 128, bits: int = 8) -> QuantizedTensor:
+    """Symmetric per-block quantization along dim -2 (contraction dim)."""
+    assert bits in (4, 8)
+    orig_shape = w.shape
+    d = w.shape[-2]
+    if d % block != 0:
+        block = d  # fall back to per-column over the whole dim
+    G = d // block
+    wb = w.astype(jnp.float32).reshape(*w.shape[:-2], G, block, w.shape[-1])
+    qmax = 2.0 ** (bits - 1) - 1
+    amax = jnp.max(jnp.abs(wb), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    q = jnp.clip(jnp.round(wb / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return QuantizedTensor(q, scale, orig_shape, bits)
+
+
+def dequantize_blockwise(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    w = qt.qdata.astype(jnp.float32) * qt.scale
+    return w.reshape(qt.shape).astype(dtype)
+
+
+def quantize_dequantize(w: jax.Array, block: int = 128, bits: int = 8) -> jax.Array:
+    """Fake-quant roundtrip (compression training / QAT parity)."""
+    return dequantize_blockwise(quantize_blockwise(w, block, bits), w.dtype)
+
+
+def quantize_int8_symmetric(x: jax.Array, axis: int = -1):
+    """Per-slice symmetric int8 for comm compression: (q, scale)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8_symmetric(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(dtype)

@@ -96,8 +96,8 @@ def _ring_attention_local(q, k, v, seg_q, seg_k, *, causal: bool, axis: str):
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
     acc0 = jnp.zeros((B, Sq, H, hd), jnp.float32)
 
-    def step(carry, s):
-        m, l, acc, kb, vb, segb = carry
+    def accum(m, l, acc, kb, vb, segb, s):
+        """Online-softmax update with the KV block held at ring step s."""
         blk = (i - s) % sp  # whose KV block we hold at step s
         kpos = blk * Sq + jnp.arange(Sq)
         ke = jnp.repeat(kb, reps, axis=2) if reps > 1 else kb
@@ -119,15 +119,23 @@ def _ring_attention_local(q, k, v, seg_q, seg_k, *, causal: bool, axis: str):
         acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, ve.astype(jnp.float32)
         )
+        return m_new, l, acc
+
+    def step(carry, s):
+        m, l, acc, kb, vb, segb = carry
+        m, l, acc = accum(m, l, acc, kb, vb, segb, s)
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
         if segb is not None:
             segb = lax.ppermute(segb, axis, perm)
-        return (m_new, l, acc, kb, vb, segb), None
+        return (m, l, acc, kb, vb, segb), None
 
-    (m, l, acc, _, _, _), _ = lax.scan(
-        step, (m0, l0, acc0, k, v, seg_k), jnp.arange(sp)
+    # sp-1 rotated steps in the scan; final block's accum outside, so the
+    # ring does not pay a last rotation whose result is discarded
+    (m, l, acc, kb, vb, segb), _ = lax.scan(
+        step, (m0, l0, acc0, k, v, seg_k), jnp.arange(sp - 1)
     )
+    m, l, acc = accum(m, l, acc, kb, vb, segb, sp - 1)
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return (acc / denom).astype(q.dtype)
 
