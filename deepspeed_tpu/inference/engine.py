@@ -29,10 +29,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import contextlib
 
 from ..comm.topology import MeshTopology, ParallelDims
-
-
-def _nullctx():
-    return contextlib.nullcontext()
 from ..models.decoding import forward_with_cache, init_cache
 from ..models.sharding import use_topology
 from ..ops.quantizer import quantize_dequantize
@@ -101,7 +97,7 @@ class InferenceEngine:
 
         self._impl_ctx = (
             (lambda: attention_impl("auto")) if kernel_inject
-            else (lambda: _nullctx())
+            else contextlib.nullcontext
         )
 
         tp_specs = (
@@ -177,7 +173,7 @@ class InferenceEngine:
         def sample(logits, key, temperature, top_k):
             logits = logits / jnp.maximum(temperature, 1e-6)
             if top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                kth = lax.top_k(logits, top_k)[0][:, -1][:, None]
                 logits = jnp.where(logits < kth, -1e30, logits)
             greedy = jnp.argmax(logits, axis=-1)
             sampled = jax.random.categorical(key, logits, axis=-1)

@@ -77,6 +77,24 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     v_cache = lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
     )
+
+    if isinstance(cache_len, int) and cache_len == 0 and S > 1:
+        # fresh prefill: the new tokens only attend among themselves, so the
+        # registered attention impl applies (kernel injection: Pallas flash
+        # prefill on TPU); the decode matvec below stays the einsum path
+        from ..ops.attention import attention as attn_op
+
+        bias = None
+        if cfg.pos_embedding == "alibi":
+            slopes = jnp.asarray(alibi_slopes(nh))
+            rel = positions[:, None, :].astype(jnp.float32) - positions[:, :, None].astype(jnp.float32)
+            bias = slopes[None, :, None, None] * (-jnp.abs(rel))[:, None, :, :]
+        out = attn_op(q, k, v, causal=True, bias=bias)
+        out = out.reshape(B, S, nh * hd)
+        out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+        if cfg.use_bias:
+            out = out + p["bo"]
+        return out, k_cache, v_cache
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if nkv != nh:

@@ -1,0 +1,130 @@
+"""Pallas fused Adam update for TPU.
+
+Parity: deepspeed/ops/adam (FusedAdam CUDA multi-tensor kernel). The
+reference fuses the m/v/param update over flattened tensor lists to avoid
+kernel-launch overhead; on TPU the analogous win is *bandwidth*: one VMEM
+pass reads (g, m, v) and writes (update, m, v) instead of XLA's several
+fusions, operating on each leaf flattened to [rows, 128] lanes.
+
+Exposed as ``scale_by_fused_adam`` — a drop-in for optax.scale_by_adam in
+runtime/optimizers.build_optimizer(use_pallas_adam=True). CPU/mesh-test
+fallback uses the same math in plain jnp (interpret-safe).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBLANES = 8
+BLOCK_ROWS = 512  # rows of 128 lanes per grid step (512*128*4B*6buf ≈ 1.5MB VMEM)
+
+
+def _adam_kernel(g_ref, m_ref, v_ref, bc_ref, out_ref, m_out_ref, v_out_ref, *,
+                 b1, b2, eps):
+    g = g_ref[:].astype(jnp.float32)
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    bc1 = bc_ref[0]
+    bc2 = bc_ref[1]
+    out_ref[:] = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    m_out_ref[:] = m
+    v_out_ref[:] = v
+
+
+def _fused_adam_flat(g, m, v, bc, *, b1, b2, eps, interpret=None):
+    """g/m/v: [N] padded to rows*LANES; bc: [2] (bias corrections)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = g.shape[0]
+    rows = n // LANES
+    shape2d = (rows, LANES)
+    block_rows = min(rows, BLOCK_ROWS)
+    grid = (rows // block_rows,)
+    spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+    out, m_new, v_new = pl.pallas_call(
+        lambda g_ref, m_ref, v_ref, bc_ref, o, mo, vo: _adam_kernel(
+            g_ref, m_ref, v_ref, bc_ref, o, mo, vo, b1=b1, b2=b2, eps=eps
+        ),
+        grid=grid,
+        in_specs=[
+            spec,
+            spec,
+            spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[spec, spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape2d, jnp.float32),
+            jax.ShapeDtypeStruct(shape2d, jnp.float32),
+            jax.ShapeDtypeStruct(shape2d, jnp.float32),
+        ],
+        interpret=interpret,
+    )(g.reshape(shape2d), m.reshape(shape2d), v.reshape(shape2d), bc)
+    return out.reshape(n), m_new.reshape(n), v_new.reshape(n)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+class FusedAdamState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def scale_by_fused_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """optax transform: Adam moment update + normalized step in one kernel."""
+
+    def init_fn(params):
+        z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedAdamState(jnp.zeros([], jnp.int32), z(), z())
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc = jnp.stack([1 - b1**cf, 1 - b2**cf])
+
+        if _use_pallas():
+            def one(g, m, v):
+                n = g.size
+                # pad to whole (block_rows, 128) tiles: rows multiple of both
+                # the fp32 sublane count and the grid block
+                rows = -(-n // LANES)
+                rows = -(-rows // SUBLANES) * SUBLANES
+                block_rows = min(rows, BLOCK_ROWS)
+                rows = -(-rows // block_rows) * block_rows
+                pad = rows * LANES - n
+                gf = jnp.pad(g.astype(jnp.float32).reshape(-1), (0, pad))
+                mf = jnp.pad(m.reshape(-1), (0, pad))
+                vf = jnp.pad(v.reshape(-1), (0, pad))
+                out, m2, v2 = _fused_adam_flat(gf, mf, vf, bc, b1=b1, b2=b2, eps=eps)
+                return (
+                    out[:n].reshape(g.shape).astype(g.dtype),
+                    m2[:n].reshape(g.shape),
+                    v2[:n].reshape(g.shape),
+                )
+        else:
+            def one(g, m, v):
+                gf = g.astype(jnp.float32)
+                m2 = b1 * m + (1 - b1) * gf
+                v2 = b2 * v + (1 - b2) * gf * gf
+                out = (m2 / bc[0]) / (jnp.sqrt(v2 / bc[1]) + eps)
+                return out.astype(g.dtype), m2, v2
+
+        trip = jax.tree.map(one, updates, state.mu, state.nu)
+        is3 = lambda t: isinstance(t, tuple) and len(t) == 3
+        out = jax.tree.map(lambda t: t[0], trip, is_leaf=is3)
+        mu = jax.tree.map(lambda t: t[1], trip, is_leaf=is3)
+        nu = jax.tree.map(lambda t: t[2], trip, is_leaf=is3)
+        return out, FusedAdamState(count, mu, nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
