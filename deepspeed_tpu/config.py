@@ -292,6 +292,15 @@ class ElasticityConfig:
 
 
 @dataclass
+class ProgressiveLayerDropConfig:
+    """Parity: "progressive_layer_drop" section (PLD paper schedule)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@dataclass
 class SequenceParallelConfig:
     sp_size: int = 1
     mode: str = "ulysses"  # ulysses | ring
@@ -383,6 +392,9 @@ class DeepSpeedConfig:
         self.compression = _parse_dc(CompressionConfig, d.get("compression_training"))
         self.autotuning = _parse_dc(AutotuningConfig, d.get("autotuning"))
         self.elasticity = _parse_dc(ElasticityConfig, d.get("elasticity"))
+        self.progressive_layer_drop = _parse_dc(
+            ProgressiveLayerDropConfig, d.get("progressive_layer_drop")
+        )
 
         self._validate()
 
@@ -441,6 +453,12 @@ class DeepSpeedConfig:
             # reference: PipelineEngine asserts ZeRO-2/3 unsupported with pipeline
             raise DeepSpeedConfigError(
                 "ZeRO stages 2/3 are incompatible with pipeline parallelism (reference parity)"
+            )
+        if self.progressive_layer_drop.enabled and self.pipeline.stages > 1:
+            raise DeepSpeedConfigError(
+                "progressive_layer_drop is not supported with pipeline "
+                "parallelism (the stochastic layer gate would have to cross "
+                "pp stage boundaries)"
             )
         if self.sequence_parallel.mode not in ("ulysses", "ring"):
             raise DeepSpeedConfigError(

@@ -1,0 +1,2 @@
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .random_ltd import RandomLTDScheduler, random_ltd_layer  # noqa: F401

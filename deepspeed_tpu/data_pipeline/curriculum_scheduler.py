@@ -1,0 +1,71 @@
+"""Curriculum learning scheduler.
+
+Parity: deepspeed/runtime/data_pipeline/curriculum_scheduler.py. Computes a
+difficulty (e.g. sequence length) per step; the engine applies a seqlen
+curriculum by truncating the batch before `device_put`.
+
+TPU note: every distinct difficulty is a distinct compiled program shape.
+``rounding`` quantizes the difficulty (reference's
+difficulty_step) — keep it >= 64 so a run compiles a handful of programs,
+not hundreds.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+
+class CurriculumScheduler:
+    """Schedule types (reference parity): fixed_linear, fixed_root,
+    fixed_discrete."""
+
+    def __init__(self, config):
+        # accepts CurriculumConfig or a raw dict
+        if hasattr(config, "curriculum_type"):
+            self.curriculum_type = config.curriculum_type
+            self.min_difficulty = config.min_difficulty
+            self.max_difficulty = config.max_difficulty
+            self.schedule_type = config.schedule_type
+            cfg: Dict[str, Any] = dict(config.schedule_config)
+        else:
+            self.curriculum_type = config.get("curriculum_type", "seqlen")
+            self.min_difficulty = config["min_difficulty"]
+            self.max_difficulty = config["max_difficulty"]
+            self.schedule_type = config["schedule_type"]
+            cfg = dict(config.get("schedule_config", {}))
+        self.total_steps = int(cfg.get("total_curriculum_step", 10000))
+        self.rounding = int(cfg.get("difficulty_step", 8))
+        self.root_degree = int(cfg.get("root_degree", 2))
+        self.discrete_difficulties = list(cfg.get("difficulty", []))
+        self.discrete_steps = list(cfg.get("max_step", []))
+        self.current_difficulty = self.min_difficulty
+
+    def _round(self, d: float) -> int:
+        r = self.rounding
+        return max(self.min_difficulty, min(self.max_difficulty, int(d // r) * r))
+
+    def get_difficulty(self, global_steps: int) -> int:
+        s = min(max(global_steps, 0), self.total_steps)
+        frac = s / max(self.total_steps, 1)
+        if self.schedule_type == "fixed_linear":
+            d = self.min_difficulty + (self.max_difficulty - self.min_difficulty) * frac
+        elif self.schedule_type == "fixed_root":
+            d = self.min_difficulty + (
+                self.max_difficulty - self.min_difficulty
+            ) * frac ** (1.0 / self.root_degree)
+        elif self.schedule_type == "fixed_discrete":
+            d = self.discrete_difficulties[-1]
+            for diff, until in zip(self.discrete_difficulties, self.discrete_steps):
+                if global_steps <= until:
+                    d = diff
+                    break
+            return int(d)
+        else:
+            raise ValueError(f"unknown curriculum schedule {self.schedule_type!r}")
+        self.current_difficulty = self._round(d)
+        return self.current_difficulty
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty

@@ -1,0 +1,92 @@
+"""Random layerwise token dropping (random-LTD).
+
+Parity: deepspeed/runtime/data_pipeline/data_routing/basic_layer.py +
+csrc/random_ltd (gather/scatter kernels). Middle layers process a random
+subset of tokens; dropped tokens bypass the layer (identity) and are
+scattered back, so sequence shape is preserved end-to-end.
+
+TPU-native: the kept-token count per step comes from a *schedule of static
+values* (each value = one compiled program; the schedule quantizes like the
+curriculum), and gather/scatter are one-hot-free ``jnp.take_along_axis`` /
+``segment``-style scatters that XLA fuses — no custom kernel needed until
+profiling says otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_subset(rng, batch: int, seq_len: int, keep: int):
+    """[B, keep] sorted random token indices (sorted keeps RoPE monotone)."""
+    def one(key):
+        return jnp.sort(jax.random.permutation(key, seq_len)[:keep])
+
+    return jax.vmap(one)(jax.random.split(rng, batch))
+
+
+def gather_tokens(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x [B, S, ...], idx [B, K] → [B, K, ...]."""
+    extra = x.ndim - 2
+    idx_e = idx.reshape(*idx.shape, *([1] * extra))
+    return jnp.take_along_axis(x, jnp.broadcast_to(idx_e, (*idx.shape, *x.shape[2:])), axis=1)
+
+
+def scatter_tokens(x_full: jax.Array, x_kept: jax.Array, idx: jax.Array) -> jax.Array:
+    """Place processed kept tokens back into the full sequence."""
+    extra = x_full.ndim - 2
+    idx_e = idx.reshape(*idx.shape, *([1] * extra))
+    idx_b = jnp.broadcast_to(idx_e, (*idx.shape, *x_full.shape[2:]))
+    return jnp.put_along_axis(x_full, idx_b, x_kept, axis=1, inplace=False)
+
+
+def random_ltd_layer(
+    layer_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    x: jax.Array,
+    positions: jax.Array,
+    keep: int,
+    rng,
+) -> jax.Array:
+    """Run ``layer_fn(x_kept, positions_kept)`` on a random token subset;
+    dropped tokens pass through unchanged (reference basic_layer semantics).
+    """
+    B, S = x.shape[:2]
+    if keep >= S:
+        return layer_fn(x, positions)
+    idx = sample_token_subset(rng, B, S, keep)
+    x_kept = gather_tokens(x, idx)
+    pos_kept = jnp.take_along_axis(positions, idx, axis=1)
+    out_kept = layer_fn(x_kept, pos_kept)
+    return scatter_tokens(x, out_kept, idx)
+
+
+class RandomLTDScheduler:
+    """Parity: deepspeed/runtime/data_pipeline/data_routing/scheduler.py.
+
+    Linear schedule of kept-token count from min_value → seq length over
+    total steps, quantized to ``step_size`` (distinct values = distinct
+    compiled programs)."""
+
+    def __init__(self, config=None, total_layers: int = 0):
+        sched = dict(getattr(config, "random_ltd_schedule", None) or {})
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 2048))
+        self.total_steps = int(
+            sched.get("schedule_config", {}).get("total_layer_drop_step", 10000)
+            if isinstance(sched.get("schedule_config"), dict)
+            else sched.get("total_layer_drop_step", 10000)
+        )
+        self.step_size = int(sched.get("seq_step", 64))
+        self.total_layers = total_layers or int(
+            getattr(config, "total_layer_num", 0) or 0
+        )
+        self.ltd_layers = list(getattr(config, "random_ltd_layer_id", None) or [])
+
+    def get_seq_len(self, global_steps: int) -> int:
+        frac = min(max(global_steps, 0), self.total_steps) / max(self.total_steps, 1)
+        v = self.min_value + (self.max_value - self.min_value) * frac
+        v = int(v // self.step_size) * self.step_size
+        return max(self.min_value, min(self.max_value, v))

@@ -1,0 +1,5 @@
+from .elasticity import (  # noqa: F401
+    compute_elastic_config,
+    get_compatible_gpus,
+    get_valid_gpus,
+)

@@ -1,0 +1,95 @@
+"""Batch-size elasticity.
+
+Parity: deepspeed/elasticity/elasticity.py — given candidate micro-batch
+sizes and a max global batch, enumerate the chip counts ("gpus" in the
+reference; TPU chips here) that can train with an *identical* global batch
+size, so a job can scale up/down across preemptions without changing the
+math. The algorithm is the reference's: valid global batches are
+micro_batch x accumulation-step multiples; pick the batch with the most
+compatible world sizes (prefer larger batch on ties per config).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..config import ElasticityConfig
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_gpus: int, max_gpus: int) -> List[int]:
+    """World sizes that divide batch/micro evenly for some micro batch."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb != 0:
+            continue
+        max_steps = batch_size // mb
+        for gpus in range(min_gpus, max_gpus + 1):
+            if max_steps % gpus == 0:
+                valid.add(gpus)
+    return sorted(valid)
+
+
+def get_compatible_gpus(
+    micro_batches: List[int],
+    max_train_batch_size: int,
+    min_gpus: int = 1,
+    max_gpus: int = 10000,
+    prefer_larger: bool = True,
+) -> Tuple[List[int], int]:
+    """Parity: elasticity._get_compatible_gpus → (valid world sizes, batch)."""
+    candidate: Dict[int, List[int]] = {}
+    for mb in sorted(micro_batches):
+        # multiples of mb up to the cap
+        b = (max_train_batch_size // mb) * mb
+        while b > 0:
+            gpus = get_valid_gpus(b, micro_batches, min_gpus, max_gpus)
+            if gpus:
+                candidate.setdefault(b, gpus)
+            b -= mb
+    if not candidate:
+        raise ValueError(
+            f"no valid batch size under {max_train_batch_size} for "
+            f"micro_batches {micro_batches}"
+        )
+    best = max(
+        candidate.items(),
+        key=lambda kv: (len(kv[1]), kv[0] if prefer_larger else -kv[0]),
+    )
+    return best[1], best[0]
+
+
+def compute_elastic_config(
+    ds_config: dict, target_deepspeed_version: str = "", world_size: int = 0
+) -> Tuple[int, List[int], int]:
+    """Parity: deepspeed.elasticity.compute_elastic_config.
+
+    Returns (final_batch_size, valid_world_sizes, micro_batch_for_world).
+    """
+    section = ds_config.get("elasticity", {})
+    cfg = ElasticityConfig(**{
+        k: v for k, v in section.items()
+        if k in ElasticityConfig.__dataclass_fields__
+    })
+    if not cfg.enabled:
+        raise ValueError("elasticity section not enabled in config")
+    valid_gpus, batch = get_compatible_gpus(
+        cfg.micro_batch_sizes,
+        cfg.max_train_batch_size,
+        cfg.min_gpus,
+        cfg.max_gpus,
+        cfg.prefer_larger_batch,
+    )
+    micro = 0
+    if world_size:
+        if world_size not in valid_gpus:
+            raise ValueError(
+                f"world size {world_size} incompatible with elastic batch "
+                f"{batch} (valid: {valid_gpus})"
+            )
+        steps = batch // world_size
+        for mb in sorted(cfg.micro_batch_sizes, reverse=True):
+            if steps % mb == 0:
+                micro = mb
+                break
+    return batch, valid_gpus, micro

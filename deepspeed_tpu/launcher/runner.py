@@ -1,0 +1,163 @@
+"""Launcher CLI.
+
+Parity: deepspeed/launcher/runner.py + launch.py (`deepspeed` command):
+hostfile parsing, --include/--exclude filters, resource ordering, and
+per-host process launch. TPU-native differences:
+
+- Single host is pure SPMD: ONE process drives every local chip (the
+  reference spawns one rank per GPU), so `deepspeed_tpu train.py` simply
+  execs the script — jax discovers local devices.
+- Multi-host runs one process per host (not per chip):
+  `jax.distributed.initialize(coordinator, num_processes, process_id)` is
+  driven by env vars this launcher exports (DSTPU_COORDINATOR etc.), and
+  remote processes are started over ssh like the reference's pdsh runner.
+
+Usage:
+  deepspeed_tpu --hostfile hosts.txt train.py --deepspeed_config ds.json
+  deepspeed_tpu train.py ...                      # single host
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+DEFAULT_COORD_PORT = 29500
+
+
+def parse_hostfile(path_or_text: str, is_text: bool = False) -> "OrderedDict[str, int]":
+    """Parity: deepspeed/launcher/runner.py parse_resource_filter inputs.
+
+    Lines: `<hostname> slots=<n>`; '#' comments; returns host → slot count
+    (slots = chips on that host; informational on TPU, the process count is
+    one per host)."""
+    text = path_or_text if is_text else open(path_or_text).read()
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    for raw in text.splitlines():
+        line = raw.split("#")[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        host = parts[0]
+        slots = 1
+        for p in parts[1:]:
+            if p.startswith("slots="):
+                slots = int(p.split("=", 1)[1])
+        if host in resources:
+            raise ValueError(f"duplicate host {host} in hostfile")
+        resources[host] = slots
+    return resources
+
+
+def parse_inclusion_exclusion(
+    resources: Dict[str, int],
+    include_str: str = "",
+    exclude_str: str = "",
+) -> "OrderedDict[str, int]":
+    """Parity: deepspeed runner --include/--exclude (host[:slot,slot] syntax;
+    slot filters are accepted but only whole-host filtering matters on TPU)."""
+
+    def hosts_of(spec: str) -> List[str]:
+        return [h.split(":")[0] for h in spec.split("@") if h]
+
+    filtered = OrderedDict(resources)
+    if include_str:
+        keep = hosts_of(include_str)
+        unknown = [h for h in keep if h not in resources]
+        if unknown:
+            raise ValueError(f"--include hosts not in hostfile: {unknown}")
+        filtered = OrderedDict((h, resources[h]) for h in keep)
+    for h in hosts_of(exclude_str):
+        if h not in resources:
+            raise ValueError(f"--exclude host not in hostfile: {h}")
+        filtered.pop(h, None)
+    if not filtered:
+        raise ValueError("no hosts left after include/exclude filtering")
+    return filtered
+
+
+def build_launch_env(
+    coordinator: str,
+    port: int,
+    num_processes: int,
+    process_id: int,
+    base_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update(
+        {
+            "DSTPU_COORDINATOR": f"{coordinator}:{port}",
+            "DSTPU_NUM_PROCESSES": str(num_processes),
+            "DSTPU_PROCESS_ID": str(process_id),
+        }
+    )
+    return env
+
+
+def build_ssh_command(host: str, env: Dict[str, str], argv: List[str]) -> List[str]:
+    """The per-host remote command (reference: pdsh/OpenMPI runner)."""
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in env.items()
+        if k.startswith(("DSTPU_", "JAX_", "TPU_", "PYTHON"))
+    )
+    remote = f"cd {shlex.quote(os.getcwd())} && {exports} {shlex.join(argv)}"
+    return ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="deepspeed_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--hostfile", default=None)
+    parser.add_argument("--include", default="")
+    parser.add_argument("--exclude", default="")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_addr", default=None)
+    parser.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print the launch plan without executing")
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    prog = [sys.executable, args.script, *args.script_args]
+
+    if not args.hostfile:
+        # single host, pure SPMD: exec in place
+        if args.dry_run:
+            print(f"[single-host] exec: {shlex.join(prog)}")
+            return 0
+        os.execvpe(prog[0], prog, os.environ.copy())
+
+    resources = parse_hostfile(args.hostfile)
+    resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    hosts = list(resources)
+    if args.num_nodes > 0:
+        hosts = hosts[: args.num_nodes]
+    coordinator = args.master_addr or hosts[0]
+
+    procs = []
+    for pid, host in enumerate(hosts):
+        env = build_launch_env(coordinator, args.master_port, len(hosts), pid)
+        cmd = build_ssh_command(host, env, prog)
+        if args.dry_run:
+            print(f"[{host} rank {pid}] {shlex.join(cmd)}")
+            continue
+        procs.append(subprocess.Popen(cmd))
+    if args.dry_run:
+        return 0
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -283,10 +283,14 @@ def _mlp(cfg: TransformerConfig, p: Params, x: jax.Array, rng: Optional[jax.Arra
 
 def _block(cfg: TransformerConfig, layer: Params, x: jax.Array, positions: jax.Array,
            segment_ids: Optional[jax.Array], rng: Optional[jax.Array], train: bool):
+    from jax.ad_checkpoint import checkpoint_name
+
     h = _attention(cfg, layer["attn"], _norm(cfg, layer["ln1"], x), positions, segment_ids)
+    h = checkpoint_name(h, "attn_out")  # selective remat anchor (attn_only)
     x = x + h
     x = constrain(x, ("dp", "fsdp"), "sp", None)
     m, aux = _mlp(cfg, layer["mlp"], _norm(cfg, layer["ln2"], x), rng, train)
+    m = checkpoint_name(m, "mlp_out")
     x = x + m
     x = constrain(x, ("dp", "fsdp"), "sp", None)
     return x, aux
@@ -294,14 +298,31 @@ def _block(cfg: TransformerConfig, layer: Params, x: jax.Array, positions: jax.A
 
 def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
                       positions: jax.Array, segment_ids, rng, train: bool,
-                      remat_policy: Optional[str] = None):
-    """Scan the stacked layer params over the sequence of blocks."""
+                      remat_policy: Optional[str] = None, pld_keep=None):
+    """Scan the stacked layer params over the sequence of blocks.
+
+    pld_keep: optional [L] per-layer keep probabilities (progressive layer
+    dropping) — a dropped layer passes its input through unchanged."""
     num_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    use_pld = pld_keep is not None and train
+    if use_pld and rng is None:
+        raise ValueError(
+            "progressive layer drop needs an rng (with rng=None every layer "
+            "would fold the same zero key and the gates would be a fixed "
+            "deterministic cut instead of per-layer/per-step sampling)"
+        )
 
     def body(carry, inp):
         x, aux = carry
-        layer, key = inp
+        if use_pld:
+            layer, key, keep_p = inp
+        else:
+            layer, key = inp
         out, a = _block(cfg, layer, x, positions, segment_ids, key, train)
+        if use_pld:
+            keep = jax.random.bernoulli(jax.random.fold_in(key, 7), keep_p)
+            out = jnp.where(keep, out, x)
+            a = jnp.where(keep, a, 0.0)
         return (out, aux + a), None
 
     if remat_policy and remat_policy != "none":
@@ -314,7 +335,8 @@ def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
         if rng is not None
         else jnp.zeros((num_layers, 2), jnp.uint32)
     )
-    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), (layers, keys))
+    xs = (layers, keys, pld_keep) if use_pld else (layers, keys)
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
     return x, aux
 
 
@@ -337,10 +359,14 @@ def embed_tokens(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
 
 
 def lm_head_logits(cfg: TransformerConfig, params: Params, y: jax.Array) -> jax.Array:
-    """Final projection → fp32 logits [..., S, V] (vocab tp-sharded)."""
+    """Final projection → fp32 logits [..., S, V] (vocab tp-sharded).
+
+    Operands stay in the compute dtype (bf16 → full MXU rate) with fp32
+    accumulation; an fp32×fp32 matmul here would run ~8x slower on TPU."""
     head = params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum(
-        "...sd,dv->...sv", y.astype(jnp.float32), head.astype(jnp.float32)
+        "...sd,dv->...sv", y, head.astype(y.dtype),
+        preferred_element_type=jnp.float32,
     )
     lead = (None,) * (y.ndim - 3)
     return constrain(logits, *lead, ("dp", "fsdp"), "sp", "tp")
@@ -368,7 +394,7 @@ def masked_ce(logits: jax.Array, labels: jax.Array, num_mb_dims: int = 0):
 def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
           dtype=jnp.bfloat16, train: bool = False, rng: Optional[jax.Array] = None,
           positions: Optional[jax.Array] = None, segment_ids=None,
-          remat_policy: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
+          remat_policy: Optional[str] = None, pld_keep=None) -> Tuple[jax.Array, jax.Array]:
     """Forward pass → (logits fp32 [B,S,V], moe_aux_loss)."""
     B, S = input_ids.shape
     if positions is None:
@@ -378,7 +404,8 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
     )
     x = embed_tokens(cfg, params, input_ids, positions, dtype)
     x, aux = apply_layer_stack(
-        cfg, cast(params["layers"]), x, positions, segment_ids, rng, train, remat_policy
+        cfg, cast(params["layers"]), x, positions, segment_ids, rng, train,
+        remat_policy, pld_keep,
     )
     x = _norm(cfg, cast(params["final_norm"]), x)
     return lm_head_logits(cfg, params, x), aux
@@ -386,12 +413,12 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
 
 def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array], *,
             dtype=jnp.bfloat16, train: bool = True, rng=None,
-            remat_policy: Optional[str] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            remat_policy: Optional[str] = None, pld_keep=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy (fp32), labels < 0 are ignored (HF -100 style)."""
     logits, aux = apply(
         cfg, params, batch["input_ids"], dtype=dtype, train=train, rng=rng,
         segment_ids=batch.get("segment_ids"), positions=batch.get("positions"),
-        remat_policy=remat_policy,
+        remat_policy=remat_policy, pld_keep=pld_keep,
     )
     ce, denom = masked_ce(logits, batch["labels"])
     total = ce + cfg.moe_aux_loss_coef * aux if cfg.is_moe else ce

@@ -57,12 +57,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(should_run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        # keep operands in input dtype (bf16 → full MXU rate), accumulate fp32
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        ) * scale  # [bq, bk] fp32
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
 
@@ -73,7 +74,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
@@ -141,24 +143,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(should_run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]  # [bq, d]
         lse = lse_ref[0, 0][:, :1]  # [bq, 1]
         delta = delta_ref[0, 0][:, :1]  # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        ) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk] fp32
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == nk - 1)
@@ -181,10 +184,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(should_run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d] (unscaled; see dk below)
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]  # [bq, d] (unscaled; see dk below)
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
@@ -192,16 +195,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk] fp32
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [bk, d]
 
     @pl.when(qi == nq - 1)

@@ -39,7 +39,14 @@ def _bwd_kernel(x_ref, s_ref, g_ref, dx_ref, ds_ref, *, eps):
     # dx = rstd * (gs - xhat * mean(gs * xhat))
     dot = jnp.mean(gs * xhat, axis=-1, keepdims=True)
     dx_ref[:] = (rstd * (gs - xhat * dot)).astype(dx_ref.dtype)
-    ds_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)  # block partial
+    # dscale: TPU grid runs sequentially, so accumulate into one (8, D)
+    # block (min sublane tile); host reads row 0
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        ds_ref[:] = jnp.zeros_like(ds_ref)
+
+    partial = jnp.sum(g * xhat, axis=0, keepdims=True)  # (1, D)
+    ds_ref[:] = ds_ref[:] + jnp.broadcast_to(partial, ds_ref.shape)
 
 
 def _interpret() -> bool:
@@ -78,7 +85,7 @@ def _run_bwd(x2, scale, g2, eps):
     g2, _ = _pad_rows(g2, block)
     rows, D = x2.shape
     nblocks = rows // block
-    dx, ds_part = pl.pallas_call(
+    dx, ds_acc = pl.pallas_call(
         functools.partial(_bwd_kernel, eps=eps),
         grid=(nblocks,),
         in_specs=[
@@ -88,15 +95,15 @@ def _run_bwd(x2, scale, g2, eps):
         ],
         out_specs=[
             pl.BlockSpec((block, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (i, 0)),
+            pl.BlockSpec((8, D), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, D), x2.dtype),
-            jax.ShapeDtypeStruct((nblocks, D), jnp.float32),
+            jax.ShapeDtypeStruct((8, D), jnp.float32),
         ],
         interpret=_interpret(),
     )(x2, scale.reshape(1, D), g2)
-    return dx[:valid_rows], ds_part.sum(0)
+    return dx[:valid_rows], ds_acc[0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

@@ -24,8 +24,9 @@ def _register_policies():
             # save matmul outputs (cheap recompute for elementwise only)
             "dots_saveable": cp.dots_saveable,
             "dots_with_no_batch_dims": cp.dots_with_no_batch_dims_saveable,
-            # save only named attention outputs (see models/transformer.py)
+            # save only named activations (tagged in models/transformer._block)
             "attn_only": cp.save_only_these_names("attn_out"),
+            "attn_mlp": cp.save_only_these_names("attn_out", "mlp_out"),
             "nothing": cp.nothing_saveable,
         }
     )

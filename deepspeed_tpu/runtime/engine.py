@@ -166,6 +166,21 @@ class TpuEngine:
         self.fp16_enabled = config.fp16.enabled
         self.compute_dtype = config.compute_dtype
         self.remat_policy = config.activation_checkpointing.policy
+        self.pld = None
+        if config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.pld = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta,
+                gamma=config.progressive_layer_drop.gamma,
+            )
+        self.curriculum = None
+        if config.data_efficiency.curriculum_learning.enabled:
+            from ..data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum = CurriculumScheduler(
+                config.data_efficiency.curriculum_learning
+            )
         if topology.sp_size > 1:
             # per-topology, so two engines with different modes don't fight
             topology.sp_mode = config.sequence_parallel.mode
@@ -243,7 +258,10 @@ class TpuEngine:
         )
 
     # ------------------------------------------------------------------ step
-    def _loss_for(self, params, mb, key, scale):
+    def _loss_for(self, params, mb, key, scale, pld_keep=None):
+        kw = {}
+        if pld_keep is not None:
+            kw["pld_keep"] = pld_keep
         loss, metrics = self.model.loss(
             params,
             mb,
@@ -251,15 +269,37 @@ class TpuEngine:
             train=True,
             rng=key,
             remat_policy=self.remat_policy,
+            **kw,
         )
         return loss * scale, (loss, metrics)
 
-    def _compute_grads(self, params, batch, rng, scale):
+    def _pld_keep(self, step):
+        """[L] per-layer keep probs when progressive layer drop is on."""
+        if self.pld is None:
+            return None
+        from .progressive_layer_drop import layer_keep_probs
+
+        return layer_keep_probs(
+            self.pld.get_theta(step), self.model.config.num_layers
+        )
+
+    def _compute_grads(self, params, batch, rng, scale, step=None):
         """(grads fp32 mean-over-microbatches, mean loss). ``batch`` has a
         leading grad-accum dim. Overridden by PipelineEngine (the pipeline
         schedule consumes all microbatches in one pipelined pass)."""
         accum = self.config.gradient_accumulation_steps
         grad_fn = jax.value_and_grad(self._loss_for, has_aux=True)
+        pld_keep = self._pld_keep(step)
+        if accum == 1:
+            # fast path: no scan, no zeros-init accumulator HBM traffic
+            key = jax.random.fold_in(rng, 0)
+            (_, (loss, _m)), grads = grad_fn(
+                params, jax.tree.map(lambda x: x[0], batch), key, scale, pld_keep
+            )
+            inv = 1.0 / scale
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+            return grads, loss
+
         zero_grads = jax.tree.map(
             lambda x: jnp.zeros(x.shape, jnp.float32), params
         )
@@ -267,7 +307,7 @@ class TpuEngine:
         def accum_body(carry, xs):
             g_acc, loss_acc = carry
             mb, key = xs
-            (_, (loss, _m)), grads = grad_fn(params, mb, key, scale)
+            (_, (loss, _m)), grads = grad_fn(params, mb, key, scale, pld_keep)
             g_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), g_acc, grads)
             return (g_acc, loss_acc + loss), None
 
@@ -282,7 +322,7 @@ class TpuEngine:
     def _train_step(self, params, opt_state, loss_scale, step, batch, rng):
         cfg = self.config
         scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
-        grads, loss = self._compute_grads(params, batch, rng, scale)
+        grads, loss = self._compute_grads(params, batch, rng, scale, step)
 
         # ZeRO>=2: materialize grads sharded (psum → reduce-scatter)
         if cfg.zero_config.stage >= 2 and self.topology.world_size > 1:
@@ -303,11 +343,14 @@ class TpuEngine:
         updates, new_opt = self.optimizer_tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
 
-        def sel(new, old):
-            return jax.tree.map(lambda a, b: jnp.where(overflow, b, a), new, old)
+        if self.fp16_enabled:
+            # overflow → keep old state (skip step); bf16/fp32 never overflow
+            # this way, so skip the full-state select (HBM traffic)
+            def sel(new, old):
+                return jax.tree.map(lambda a, b: jnp.where(overflow, b, a), new, old)
 
-        new_params = sel(new_params, params)
-        new_opt = sel(new_opt, opt_state)
+            new_params = sel(new_params, params)
+            new_opt = sel(new_opt, opt_state)
         new_scale = update_loss_scale(loss_scale, overflow, cfg.fp16, self.fp16_enabled)
         # skipped steps don't advance the schedule (reference scheduler parity)
         new_step = step + jnp.where(overflow, 0, 1).astype(step.dtype)
@@ -383,6 +426,15 @@ class TpuEngine:
             from ..models.transformer import make_lm_batch
 
             batch = make_lm_batch(jnp.asarray(batch["input_ids"]))
+        if self.curriculum is not None and self.curriculum.curriculum_type == "seqlen":
+            # seqlen curriculum: truncate before upload (reference parity:
+            # curriculum_scheduler + the engine's seqlen reshape). Each
+            # distinct difficulty compiles one program (rounding bounds it).
+            difficulty = self.curriculum.update_difficulty(self.global_steps)
+            batch = {
+                k: (np.asarray(v)[:, :difficulty] if np.asarray(v).ndim >= 2 else v)
+                for k, v in batch.items()
+            }
         prepared = self._prepare_batch(batch)
         with use_topology(self.topology):
             p, o, s, st, metrics = self._jit_train(
@@ -392,7 +444,9 @@ class TpuEngine:
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         self._metrics = {k: v for k, v in metrics.items()}
-        if bool(metrics["overflow"]):
+        # only the fp16 path reads overflow on host — a host read here forces
+        # a device sync every step and kills async dispatch overlap
+        if self.fp16_enabled and bool(metrics["overflow"]):
             self.skipped_steps += 1
             log_dist(
                 f"step {self.global_steps}: fp16 overflow, skipping update "

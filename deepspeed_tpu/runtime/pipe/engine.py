@@ -42,7 +42,7 @@ class PipelineEngine(TpuEngine):
             )
         super().__init__(model=model, config=config, topology=topology, **kw)
 
-    def _compute_grads(self, params, batch, rng, scale):
+    def _compute_grads(self, params, batch, rng, scale, step=None):
         def scaled_loss(p):
             loss, _metrics = self.model.pipeline_loss(
                 p,

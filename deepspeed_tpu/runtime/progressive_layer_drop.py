@@ -1,0 +1,37 @@
+"""Progressive layer dropping (PLD).
+
+Parity: deepspeed/runtime/progressive_layer_drop.py (Zhang & He 2020). The
+global keep ratio follows theta(t) = (1 - theta) * exp(-gamma * t) + theta
+(reference's schedule), and depth scales it linearly: layer i of L keeps
+with probability 1 - i/L * (1 - theta(t)) — shallow layers almost always
+run, deep layers drop progressively harder early in training.
+
+TPU-native: the per-layer Bernoulli gate runs *inside* the jitted train
+step (theta is a traced function of the step counter), so PLD costs one
+[L]-sized sample per step and a select per layer — no recompilation as the
+schedule anneals, unlike shape-based approaches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+
+    def get_theta(self, global_step):
+        t = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * t) + self.theta
+
+    def get_state(self, global_step):
+        return {"pld_theta": self.get_theta(global_step)}
+
+
+def layer_keep_probs(theta_t, num_layers: int):
+    """Per-layer keep probabilities [L]: 1 - i/L * (1 - theta_t)."""
+    i = jnp.arange(num_layers, dtype=jnp.float32)
+    return 1.0 - (i / max(num_layers, 1)) * (1.0 - theta_t)

@@ -1,0 +1,231 @@
+"""Launcher, elasticity, curriculum, random-LTD, PLD (SURVEY §2.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.data_pipeline.random_ltd import (
+    RandomLTDScheduler,
+    gather_tokens,
+    random_ltd_layer,
+    sample_token_subset,
+    scatter_tokens,
+)
+from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
+from deepspeed_tpu.launcher.runner import (
+    build_launch_env,
+    build_ssh_command,
+    main as launcher_main,
+    parse_hostfile,
+    parse_inclusion_exclusion,
+)
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    ProgressiveLayerDrop,
+    layer_keep_probs,
+)
+
+
+# ---------------------------------------------------------------- launcher
+def test_parse_hostfile():
+    text = """
+    # my cluster
+    host1 slots=4
+    host2 slots=8
+    host3
+    """
+    res = parse_hostfile(text, is_text=True)
+    assert res == {"host1": 4, "host2": 8, "host3": 1}
+    with pytest.raises(ValueError):
+        parse_hostfile("h slots=1\nh slots=2", is_text=True)
+
+
+def test_include_exclude():
+    res = {"a": 4, "b": 4, "c": 4}
+    assert list(parse_inclusion_exclusion(res, include_str="a@c")) == ["a", "c"]
+    assert list(parse_inclusion_exclusion(res, exclude_str="b")) == ["a", "c"]
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(res, include_str="zzz")
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(res, exclude_str="a@b@c")
+
+
+def test_launch_env_and_ssh_command():
+    env = build_launch_env("host1", 29500, 4, 2, base_env={"PYTHONPATH": "/x"})
+    assert env["DSTPU_COORDINATOR"] == "host1:29500"
+    assert env["DSTPU_PROCESS_ID"] == "2"
+    cmd = build_ssh_command("host2", env, ["python", "train.py"])
+    assert cmd[0] == "ssh" and "host2" in cmd
+    assert "DSTPU_COORDINATOR=host1:29500" in cmd[-1]
+    assert "python train.py" in cmd[-1]
+
+
+def test_launcher_dry_run(tmp_path, capsys):
+    hf = tmp_path / "hosts"
+    hf.write_text("h1 slots=4\nh2 slots=4\n")
+    rc = launcher_main(
+        ["--hostfile", str(hf), "--dry_run", "train.py", "--flag"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[h1 rank 0]" in out and "[h2 rank 1]" in out
+
+
+# --------------------------------------------------------------- elasticity
+def test_get_compatible_gpus():
+    gpus, batch = get_compatible_gpus(
+        micro_batches=[2, 4], max_train_batch_size=64, min_gpus=1, max_gpus=16
+    )
+    assert batch <= 64
+    for g in gpus:
+        assert any(batch % (mb * g) == 0 for mb in [2, 4])
+
+
+def test_compute_elastic_config():
+    ds = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 100,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 8,
+        }
+    }
+    batch, valid, micro = compute_elastic_config(ds, world_size=4)
+    assert 4 in valid and batch % (micro * 4) == 0 and micro in (2, 4)
+    with pytest.raises(ValueError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+# --------------------------------------------------------------- curriculum
+def test_curriculum_schedules():
+    cs = CurriculumScheduler(
+        {
+            "curriculum_type": "seqlen",
+            "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+        }
+    )
+    assert cs.get_difficulty(0) == 8
+    assert cs.get_difficulty(100) == 64
+    mid = cs.get_difficulty(50)
+    assert 8 <= mid <= 64 and mid % 8 == 0
+
+    disc = CurriculumScheduler(
+        {
+            "curriculum_type": "seqlen",
+            "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [8, 32, 64], "max_step": [10, 20, 30]},
+        }
+    )
+    assert disc.get_difficulty(5) == 8
+    assert disc.get_difficulty(15) == 32
+    assert disc.get_difficulty(999) == 64
+
+
+def test_curriculum_engine_truncates_seq():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2("gpt2-tiny", vocab_size=64, max_seq_len=32, hidden_size=32,
+                   num_layers=2, num_heads=2),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "data_efficiency": {
+                "enabled": True,
+                "data_sampling": {
+                    "curriculum_learning": {
+                        "enabled": True,
+                        "curriculum_type": "seqlen",
+                        "min_difficulty": 8,
+                        "max_difficulty": 32,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 4,
+                                            "difficulty_step": 8},
+                    }
+                },
+            },
+            "steps_per_print": 100,
+        },
+        topology=MeshTopology(dims=ParallelDims(dp=8)),
+    )
+    assert engine.curriculum is not None
+    r = np.random.RandomState(0)
+    for _ in range(5):
+        loss = engine.train_batch(batch={"input_ids": r.randint(0, 64, size=(8, 32))})
+        assert np.isfinite(float(loss))
+    assert engine.curriculum.current_difficulty == 32
+
+
+# --------------------------------------------------------------- random-LTD
+def test_gather_scatter_roundtrip():
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(2, 16, 4), jnp.float32)
+    idx = sample_token_subset(jax.random.PRNGKey(0), 2, 16, 8)
+    assert idx.shape == (2, 8)
+    # sorted, unique
+    assert all(np.all(np.diff(np.asarray(idx)[b]) > 0) for b in range(2))
+    kept = gather_tokens(x, idx)
+    back = scatter_tokens(x, kept, idx)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_random_ltd_layer_identity_for_dropped():
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(2, 16, 4), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    out = random_ltd_layer(lambda xx, pp: xx * 2.0, x, pos, keep=8,
+                           rng=jax.random.PRNGKey(1))
+    doubled = np.isclose(np.asarray(out), 2 * np.asarray(x)).all(-1)
+    same = np.isclose(np.asarray(out), np.asarray(x)).all(-1)
+    assert doubled.sum() == 2 * 8  # exactly keep tokens processed per row
+    assert (doubled | same).all()
+
+
+def test_random_ltd_scheduler():
+    class C:
+        random_ltd_schedule = {"min_value": 64, "max_value": 512,
+                               "total_layer_drop_step": 100, "seq_step": 64}
+        total_layer_num = 12
+        random_ltd_layer_id = [1, 2, 3]
+
+    s = RandomLTDScheduler(C())
+    assert s.get_seq_len(0) == 64
+    assert s.get_seq_len(100) == 512
+    assert s.get_seq_len(50) % 64 == 0
+
+
+# ---------------------------------------------------------------------- PLD
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert float(pld.get_theta(0)) == pytest.approx(1.0)
+    assert float(pld.get_theta(10_000)) == pytest.approx(0.5, abs=1e-3)
+    probs = layer_keep_probs(jnp.asarray(0.5), 4)
+    np.testing.assert_allclose(np.asarray(probs), [1.0, 0.875, 0.75, 0.625])
+
+
+def test_pld_engine_trains():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                   num_layers=4, num_heads=2),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                       "gamma": 0.01},
+            "steps_per_print": 100,
+        },
+        topology=MeshTopology(dims=ParallelDims(dp=8)),
+    )
+    assert engine.pld is not None
+    r = np.random.RandomState(0)
+    for _ in range(3):
+        loss = engine.train_batch(batch={"input_ids": r.randint(0, 64, size=(8, 16))})
+        assert np.isfinite(float(loss))
