@@ -1,0 +1,111 @@
+"""Autotuner: micro-batch-size / remat-policy search.
+
+Parity: deepspeed/autotuning/autotuner.py (+ the "autotuning" config
+section). The reference launches separate ranked experiments; on TPU one
+process owns the chips, so each candidate is a fresh engine in-process:
+compile → run measured steps → throughput; OOM (XLA RESOURCE_EXHAUSTED)
+prunes the candidate and, in fast mode, everything larger.
+
+Search space: micro-batch sizes (powers of two up to
+max_train_micro_batch_size_per_gpu) × remat policies (none is tried first
+at each batch — cheapest when it fits, per the memory/compute tradeoff).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+REMAT_POLICIES = ("none", "attn_mlp", "full")
+
+
+def _is_oom(err: Exception) -> bool:
+    s = str(err)
+    return "RESOURCE_EXHAUSTED" in s or "Ran out of memory" in s or "hbm" in s.lower()
+
+
+class Autotuner:
+    def __init__(self, model, base_config: Dict[str, Any], topology=None,
+                 sample_batch_fn=None):
+        self.model = model
+        self.base_config = dict(base_config)
+        self.topology = topology
+        self.sample_batch_fn = sample_batch_fn
+        at = dict(self.base_config.get("autotuning") or {})
+        self.metric = at.get("metric", "throughput")
+        self.fast = bool(at.get("fast", True))
+        self.start_step = int(at.get("start_profile_step", 3))
+        self.end_step = int(at.get("end_profile_step", 5))
+        self.max_micro = int(at.get("max_train_micro_batch_size_per_gpu", 64))
+        self.results: List[Dict[str, Any]] = []
+
+    def _candidates(self) -> List[Tuple[int, str]]:
+        mbs = []
+        m = 1
+        while m <= self.max_micro:
+            mbs.append(m)
+            m *= 2
+        return [(mb, pol) for mb in mbs for pol in REMAT_POLICIES]
+
+    def _measure(self, micro_batch: int, remat: str) -> Optional[float]:
+        import deepspeed_tpu
+
+        cfg = dict(self.base_config)
+        cfg.pop("autotuning", None)
+        dp = self.topology.data_shard_size if self.topology else 1
+        accum = int(cfg.get("gradient_accumulation_steps", 1))
+        cfg["train_micro_batch_size_per_gpu"] = micro_batch
+        cfg["train_batch_size"] = micro_batch * dp * accum
+        cfg["activation_checkpointing"] = {"policy": remat}
+        cfg.setdefault("steps_per_print", 10**9)
+        try:
+            engine, *_ = deepspeed_tpu.initialize(
+                model=self.model, config=cfg, topology=self.topology
+            )
+            batch = self.sample_batch_fn(cfg["train_batch_size"])
+            for _ in range(self.start_step):  # compile + warmup
+                engine.train_batch(batch=dict(batch))
+            float(engine.state.step)
+            t0 = time.perf_counter()
+            n = max(self.end_step - self.start_step, 1)
+            for _ in range(n):
+                engine.train_batch(batch=dict(batch))
+            float(engine.state.step)
+            dt = (time.perf_counter() - t0) / n
+            tokens = np.asarray(batch["input_ids"]).size
+            engine.destroy()
+            return tokens / dt
+        except Exception as e:  # noqa: BLE001 — OOM pruning is the point
+            if _is_oom(e):
+                log_dist(f"autotune: mb={micro_batch} remat={remat} OOM, pruned")
+                return None
+            raise
+
+    def tune(self) -> Dict[str, Any]:
+        """Returns the best config patch {micro_batch, remat_policy, throughput}."""
+        best = None
+        oom_at = None
+        for mb, pol in self._candidates():
+            if oom_at is not None and self.fast and mb >= oom_at:
+                continue
+            tput = self._measure(mb, pol)
+            if tput is None:
+                if pol == REMAT_POLICIES[-1]:  # OOM even at max remat
+                    oom_at = mb
+                continue
+            rec = {"micro_batch": mb, "remat_policy": pol, "throughput": tput}
+            self.results.append(rec)
+            log_dist(f"autotune: mb={mb} remat={pol}: {tput:.0f} tok/s")
+            if best is None or tput > best["throughput"]:
+                best = rec
+        if best is None:
+            raise RuntimeError("autotuning found no runnable configuration")
+        return best
+
+
+def autotune(model, base_config, topology=None, sample_batch_fn=None):
+    return Autotuner(model, base_config, topology, sample_batch_fn).tune()

@@ -1,0 +1,8 @@
+from .compress import (  # noqa: F401
+    apply_layer_reduction,
+    head_pruning_mask,
+    init_compression,
+    redundancy_clean,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)

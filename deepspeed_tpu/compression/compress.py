@@ -1,0 +1,202 @@
+"""Compression: weight quantization, pruning, layer reduction.
+
+Parity: deepspeed/compression/ (compress.py, basic_layer.py, helper.py) and
+the "compression_training" config section. The reference wraps torch modules
+with QuantLinear/PruneLinear shims; here compression is a *pure function on
+the param pytree* — masks and fake-quant are applied to the stacked [L, ...]
+weights, so the same jitted train step runs compressed training with zero
+graph changes (XLA folds the masks into the matmuls).
+
+- weight_quantization: symmetric int8/int4 groupwise fake-quant (QAT
+  forward; ops/quantizer.py does the rounding).
+- sparse_pruning: magnitude mask at the configured density.
+- head_pruning: L2-norm ranking of attention heads on wo rows.
+- row_pruning: row-norm ranking of MLP wi columns... rows of wo.
+- layer_reduction: keep a teacher-selected subset of layers (distill init).
+- redundancy_clean: bake masks into the weights (the reference's
+  final cleanup pass before export).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quantizer import quantize_dequantize
+
+MATMUL_WEIGHTS = ("wq", "wk", "wv", "wo", "wi", "wg")
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return last.key if hasattr(last, "key") else str(last)
+
+
+def weight_fake_quant(params, bits: int = 8, group_size: int = 128,
+                      targets: Tuple[str, ...] = MATMUL_WEIGHTS):
+    """QAT forward pass weights (reference: WeightQuantization.forward)."""
+
+    def q(path, leaf):
+        if _leaf_name(path) in targets and leaf.ndim >= 2:
+            return quantize_dequantize(leaf, block=group_size, bits=bits)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(q, params)
+
+
+def sparse_pruning_mask(w: jax.Array, density: float) -> jax.Array:
+    """Keep the top-|density| fraction by magnitude (unstructured)."""
+    k = max(1, int(round(density * w.size)))
+    flat = jnp.abs(w).reshape(-1)
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def head_pruning_mask(wo: jax.Array, num_heads: int, ratio: float) -> jax.Array:
+    """Mask whole attention heads of a [H*hd, d] output projection.
+
+    Heads ranked by L2 norm of their wo rows; lowest (1-ratio) fraction
+    masked. Returns a [H*hd, 1]-broadcastable mask."""
+    Hhd, d = wo.shape
+    hd = Hhd // num_heads
+    norms = jnp.linalg.norm(wo.reshape(num_heads, hd * d), axis=1)
+    keep = max(1, int(round(ratio * num_heads)))
+    thresh = jnp.sort(norms)[-keep]
+    head_mask = (norms >= thresh).astype(wo.dtype)  # [H]
+    return jnp.repeat(head_mask, hd)[:, None]
+
+
+def row_pruning_mask(wi: jax.Array, ratio: float) -> jax.Array:
+    """Mask ffn rows (columns of [d, f] wi) by norm; [1, f] mask."""
+    norms = jnp.linalg.norm(wi, axis=0)
+    keep = max(1, int(round(ratio * wi.shape[1])))
+    thresh = jnp.sort(norms)[-keep]
+    return (norms >= thresh).astype(wi.dtype)[None, :]
+
+
+def apply_layer_reduction(params, keep_layers) -> Any:
+    """Distill-init: slice the stacked [L, ...] layer params to a subset.
+
+    Parity: compression layer_reduction (teacher_layer list + keep_number).
+    """
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+
+    def slice_layers(leaf):
+        return jnp.take(leaf, idx, axis=0)
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(slice_layers, params["layers"])
+    return out
+
+
+def init_compression(params, compression_config, model_config=None):
+    """Apply the "compression_training" section to a param pytree.
+
+    Returns (params, masks) — masks are reapplied after each optimizer step
+    during compressed training (engine hook) and baked in by
+    :func:`redundancy_clean`."""
+    cc = compression_config
+    masks: Dict[str, Any] = {}
+
+    wq = dict(cc.weight_quantization or {})
+    shared = dict(wq.get("shared_parameters") or {})
+    if shared.get("enabled"):
+        gs = int(shared.get("group_size", shared.get("quantize_groups", 0)) or 128)
+        all_bits = [
+            int((g.get("params") or {}).get("target_bits",
+                                            (g.get("params") or {}).get("bits", 8)))
+            for g in (wq.get("different_groups") or {}).values()
+        ] or [8]
+        if len(set(all_bits)) > 1:
+            from ..utils.logging import log_dist
+
+            log_dist(
+                f"compression: per-group bit widths {sorted(set(all_bits))} not "
+                f"yet differentiated on the stacked layout; using min "
+                f"(most conservative) = {min(all_bits)}"
+            )
+        params = weight_fake_quant(params, bits=min(all_bits), group_size=gs)
+
+    sp = dict(cc.sparse_pruning or {})
+    if (sp.get("shared_parameters") or {}).get("enabled"):
+        density = 0.5
+        for group in (sp.get("different_groups") or {}).values():
+            density = float((group.get("params") or {}).get("dense_ratio", 0.5))
+        # stacked layout: weights are [L, in, out] (ndim>=3); [L, f] biases
+        # must not be magnitude-pruned (reference prunes weights only)
+        layer_masks = jax.tree_util.tree_map_with_path(
+            lambda p, w: (
+                sparse_pruning_mask(w, density)
+                if _leaf_name(p) in MATMUL_WEIGHTS and w.ndim >= 3
+                else None
+            ),
+            params["layers"]["mlp"],
+        )
+        masks["sparse"] = layer_masks
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+        params["layers"]["mlp"] = jax.tree.map(
+            lambda w, m: w if m is None else w * m,
+            params["layers"]["mlp"],
+            layer_masks,
+            is_leaf=lambda x: x is None or hasattr(x, "ndim"),
+        )
+
+    hp = dict(cc.head_pruning or {})
+    if (hp.get("shared_parameters") or {}).get("enabled") and model_config is not None:
+        ratio = 0.5
+        for group in (hp.get("different_groups") or {}).values():
+            ratio = float((group.get("params") or {}).get("dense_ratio", 0.5))
+        wo = params["layers"]["attn"]["wo"]  # [L, H*hd, d]
+        mask = jnp.stack([
+            head_pruning_mask(wo[l], model_config.num_heads, ratio)
+            for l in range(wo.shape[0])
+        ])
+        masks["head"] = mask
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+        params["layers"]["attn"] = dict(params["layers"]["attn"])
+        params["layers"]["attn"]["wo"] = wo * mask
+
+    rp = dict(cc.row_pruning or {})
+    if (rp.get("shared_parameters") or {}).get("enabled"):
+        ratio = 0.5
+        for group in (rp.get("different_groups") or {}).values():
+            ratio = float((group.get("params") or {}).get("dense_ratio", 0.5))
+        wi = params["layers"]["mlp"]["wi"]  # [L, d, f]
+        mask = jnp.stack([row_pruning_mask(wi[l], ratio) for l in range(wi.shape[0])])
+        masks["row"] = mask
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+        params["layers"]["mlp"] = dict(params["layers"]["mlp"])
+        params["layers"]["mlp"]["wi"] = wi * mask
+
+    lr = dict(cc.layer_reduction or {})
+    if lr.get("enabled"):
+        keep = lr.get("teacher_layer") or list(
+            range(int(lr.get("keep_number", 0)))
+        )
+        params = apply_layer_reduction(params, keep)
+
+    return params, masks
+
+
+def redundancy_clean(params, masks):
+    """Bake pruning masks into weights (reference: redundancy_clean)."""
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda x: x, params["layers"])
+    if "head" in masks:
+        out["layers"]["attn"]["wo"] = out["layers"]["attn"]["wo"] * masks["head"]
+    if "row" in masks:
+        out["layers"]["mlp"]["wi"] = out["layers"]["mlp"]["wi"] * masks["row"]
+    if "sparse" in masks:
+        out["layers"]["mlp"] = jax.tree.map(
+            lambda w, m: w if m is None else w * m,
+            out["layers"]["mlp"],
+            masks["sparse"],
+            is_leaf=lambda x: x is None or hasattr(x, "ndim"),
+        )
+    return out

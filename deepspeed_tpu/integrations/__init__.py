@@ -1,0 +1,1 @@
+from .hf import HfEngineAdapter, import_hf_model, import_hf_state_dict  # noqa: F401

@@ -33,7 +33,7 @@ def _register_policies():
     if hasattr(cp, "save_and_offload_only_these_names"):
         _POLICIES["offload_host"] = cp.save_and_offload_only_these_names(
             names_which_can_be_saved=[],
-            names_which_can_be_offloaded=["attn_out", "block_out"],
+            names_which_can_be_offloaded=["attn_out", "mlp_out"],
             offload_src="device",
             offload_dst="pinned_host",
         )

@@ -1,0 +1,83 @@
+"""NVMe tensor swapping over the C++ aio backend.
+
+Parity: deepspeed/runtime/swap_tensor/ (partitioned_optimizer_swapper,
+async_swapper). Pytree leaves stream to raw .bin files under ``swap_dir``
+via the csrc/aio threadpool; reads land in preallocated host buffers so a
+swap-in overlaps with TPU compute. This is the storage layer behind
+ZeRO offload_optimizer {"device": "nvme", "nvme_path": ...}: optimizer
+state lives on disk between steps for models whose states exceed host RAM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from ..ops.aio import AsyncIOHandle
+
+
+class TensorSwapper:
+    def __init__(self, swap_dir: str, num_threads: int = 4):
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.aio = AsyncIOHandle(num_threads=num_threads)
+        self._meta: Dict[str, Any] = {}
+
+    def _leaf_path(self, name: str, i: int) -> str:
+        return os.path.join(self.swap_dir, f"{name}.leaf{i}.bin")
+
+    def swap_out(self, name: str, tree, blocking: bool = True) -> None:
+        """Write every leaf (gathered to host) to disk asynchronously."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        meta = []
+        reqs = []
+        for i, leaf in enumerate(leaves):
+            host = np.asarray(jax.device_get(leaf))
+            meta.append({"shape": list(host.shape), "dtype": str(host.dtype)})
+            reqs.append(self.aio.submit_write(self._leaf_path(name, i), host))
+        self._meta[name] = {
+            "leaves": meta,
+            "treedef": jax.tree_util.tree_structure(tree),
+        }
+        with open(os.path.join(self.swap_dir, f"{name}.json"), "w") as f:
+            json.dump({"leaves": meta}, f)
+        if blocking:
+            for r in reqs:
+                self.aio.wait(r)
+
+    def swap_in(self, name: str, treedef=None, shardings=None):
+        """Read leaves back; returns the reconstructed pytree."""
+        meta = self._meta.get(name)
+        if meta is None:
+            with open(os.path.join(self.swap_dir, f"{name}.json")) as f:
+                meta = {"leaves": json.load(f)["leaves"], "treedef": treedef}
+        if meta["treedef"] is None:
+            raise ValueError(f"swap_in({name!r}) needs a treedef")
+        bufs = []
+        reqs = []
+        for i, lm in enumerate(meta["leaves"]):
+            buf = np.empty(lm["shape"], dtype=np.dtype(lm["dtype"]))
+            reqs.append(self.aio.submit_read(self._leaf_path(name, i), buf))
+            bufs.append(buf)
+        for r in reqs:
+            self.aio.wait(r)
+        tree = jax.tree_util.tree_unflatten(meta["treedef"], bufs)
+        if shardings is not None:
+            tree = jax.device_put(tree, shardings)
+        return tree
+
+    def release(self, name: str) -> None:
+        meta = self._meta.pop(name, None)
+        if meta:
+            for i in range(len(meta["leaves"])):
+                try:
+                    os.remove(self._leaf_path(name, i))
+                except FileNotFoundError:
+                    pass
+
+    def close(self) -> None:
+        self.aio.close()

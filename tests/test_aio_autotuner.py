@@ -1,0 +1,106 @@
+"""C++ aio backend, tensor swapper, autotuner (SURVEY §2.2, §2.7)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.models import gpt2
+
+
+def test_aio_write_read_roundtrip(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    h = AsyncIOHandle(num_threads=2)
+    r = np.random.RandomState(0)
+    data = r.randn(1000).astype(np.float32)
+    path = str(tmp_path / "x.bin")
+    req = h.submit_write(path, data)
+    h.wait(req)
+    assert os.path.getsize(path) == data.nbytes
+
+    out = np.empty_like(data)
+    h.wait(h.submit_read(path, out))
+    np.testing.assert_array_equal(out, data)
+    h.close()
+
+
+def test_aio_many_concurrent(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    h = AsyncIOHandle(num_threads=4)
+    r = np.random.RandomState(1)
+    arrays = [r.randn(256 + i).astype(np.float64) for i in range(20)]
+    reqs = [
+        h.submit_write(str(tmp_path / f"f{i}.bin"), a)
+        for i, a in enumerate(arrays)
+    ]
+    h.wait_all()
+    outs = [np.empty_like(a) for a in arrays]
+    for i, o in enumerate(outs):
+        h.wait(h.submit_read(str(tmp_path / f"f{i}.bin"), o))
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+    h.close()
+
+
+def test_aio_read_missing_file_errors(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    h = AsyncIOHandle(num_threads=1)
+    buf = np.empty(16, np.float32)
+    with pytest.raises(OSError):
+        h.wait(h.submit_read(str(tmp_path / "missing.bin"), buf))
+    h.close()
+
+
+def test_tensor_swapper_roundtrip(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
+
+    sw = TensorSwapper(str(tmp_path), num_threads=2)
+    tree = {
+        "a": jnp.arange(32, dtype=jnp.float32).reshape(4, 8),
+        "b": {"c": jnp.ones((3,), jnp.int32)},
+    }
+    sw.swap_out("opt", tree)
+    back = sw.swap_in("opt")
+    for x, y in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    sw.release("opt")
+    assert not any(f.endswith(".bin") for f in os.listdir(tmp_path))
+    sw.close()
+
+
+def test_autotuner_picks_best():
+    from deepspeed_tpu.autotuning import Autotuner
+
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    topo = MeshTopology(dims=ParallelDims(dp=8))
+    r = np.random.RandomState(0)
+
+    def sample_batch(global_batch):
+        return {"input_ids": r.randint(0, 64, size=(global_batch, 16))}
+
+    tuner = Autotuner(
+        model,
+        {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "autotuning": {
+                "enabled": True,
+                "max_train_micro_batch_size_per_gpu": 2,
+                "start_profile_step": 1,
+                "end_profile_step": 2,
+            },
+        },
+        topology=topo,
+        sample_batch_fn=sample_batch,
+    )
+    best = tuner.tune()
+    assert best["micro_batch"] in (1, 2)
+    assert best["remat_policy"] in ("none", "attn_mlp", "full")
+    assert best["throughput"] > 0
+    assert len(tuner.results) >= 2

@@ -1,0 +1,184 @@
+"""Compression transforms + HF Transformers bridge (SURVEY §2.7, §2.8).
+
+HF parity oracle: logits from our imported params match the torch model's
+logits on the same tokens (fp32, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (
+    apply_layer_reduction,
+    head_pruning_mask,
+    init_compression,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)
+from deepspeed_tpu.config import CompressionConfig
+from deepspeed_tpu.integrations.hf import import_hf_model
+from deepspeed_tpu.models import gpt2
+
+
+# ------------------------------------------------------------- compression
+def test_sparse_pruning_mask_density():
+    r = np.random.RandomState(0)
+    w = jnp.asarray(r.randn(32, 64), jnp.float32)
+    m = sparse_pruning_mask(w, 0.25)
+    assert abs(float(m.mean()) - 0.25) < 0.02
+    # highest-magnitude entries survive
+    assert float(jnp.abs(w * m).max()) == float(jnp.abs(w).max())
+
+
+def test_head_and_row_pruning_masks():
+    r = np.random.RandomState(1)
+    wo = jnp.asarray(r.randn(8 * 16, 32), jnp.float32)
+    m = head_pruning_mask(wo, num_heads=8, ratio=0.5)
+    assert m.shape == (128, 1)
+    per_head = np.asarray(m).reshape(8, 16)
+    assert set(per_head.min(1)) <= {0.0, 1.0}
+    assert per_head.min(1).sum() == 4  # half the heads kept
+
+    wi = jnp.asarray(r.randn(32, 64), jnp.float32)
+    rm = row_pruning_mask(wi, 0.25)
+    assert rm.shape == (1, 64) and int(rm.sum()) == 16
+
+
+def test_layer_reduction():
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=4, num_heads=2)
+    params = model.init(jax.random.PRNGKey(0))
+    reduced = apply_layer_reduction(params, [0, 3])
+    assert reduced["layers"]["attn"]["wq"].shape[0] == 2
+    np.testing.assert_array_equal(
+        np.asarray(reduced["layers"]["attn"]["wq"][1]),
+        np.asarray(params["layers"]["attn"]["wq"][3]),
+    )
+
+
+def test_init_compression_full_config():
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    params = model.init(jax.random.PRNGKey(0))
+    cc = CompressionConfig(
+        weight_quantization={
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"g1": {"params": {"target_bits": 8}}},
+        },
+        sparse_pruning={
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"g1": {"params": {"dense_ratio": 0.5}}},
+        },
+        head_pruning={
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"g1": {"params": {"dense_ratio": 0.5}}},
+        },
+        row_pruning={
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"g1": {"params": {"dense_ratio": 0.5}}},
+        },
+    )
+    new_params, masks = init_compression(params, cc, model.config)
+    assert "head" in masks and "row" in masks and "sparse" in masks
+    # model still runs and produces finite loss
+    from deepspeed_tpu.models.transformer import make_lm_batch
+
+    batch = make_lm_batch(jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, size=(2, 8))))
+    loss, _ = model.loss(new_params, batch, dtype=jnp.float32)
+    assert np.isfinite(float(loss))
+
+
+# ----------------------------------------------------------------- HF parity
+def _logit_parity(hf_model, ids, atol=2e-3):
+    import torch
+
+    model, params = import_hf_model(hf_model)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.float().numpy()
+    got, _ = model.apply(
+        jax.tree.map(jnp.asarray, params), jnp.asarray(ids), dtype=jnp.float32
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-3, atol=atol)
+
+
+def test_hf_gpt2_parity():
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=2
+    )).eval()
+    ids = np.random.RandomState(0).randint(0, 128, size=(2, 8))
+    _logit_parity(hf, ids)
+
+
+def test_hf_llama_parity():
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(1)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, rms_norm_eps=1e-5,
+    )).eval()
+    ids = np.random.RandomState(1).randint(0, 128, size=(2, 8))
+    _logit_parity(hf, ids)
+
+
+def test_hf_bloom_parity():
+    import torch
+    from transformers import BloomConfig, BloomForCausalLM
+
+    torch.manual_seed(2)
+    hf = BloomForCausalLM(BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5,
+    )).eval()
+    ids = np.random.RandomState(2).randint(0, 128, size=(2, 8))
+    _logit_parity(hf, ids, atol=5e-3)
+
+
+def test_hf_mixtral_import_runs():
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(3)
+    hf = MixtralForCausalLM(MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=32,
+    )).eval()
+    model, params = import_hf_model(hf)
+    ids = np.random.RandomState(3).randint(0, 128, size=(2, 8))
+    logits, _ = model.apply(
+        jax.tree.map(jnp.asarray, params), jnp.asarray(ids), dtype=jnp.float32
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_hf_engine_adapter_trains():
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from deepspeed_tpu.integrations.hf import HfEngineAdapter
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    torch.manual_seed(4)
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=2
+    ))
+    adapter = HfEngineAdapter(
+        hf,
+        {"train_batch_size": 8,
+         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+         "zero_optimization": {"stage": 1}, "steps_per_print": 100},
+        topology=MeshTopology(dims=ParallelDims(dp=8)),
+    )
+    loss = adapter.train_batch(
+        batch={"input_ids": np.random.RandomState(4).randint(0, 128, size=(8, 16))}
+    )
+    assert np.isfinite(float(loss))
