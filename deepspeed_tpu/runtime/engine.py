@@ -174,6 +174,15 @@ class TpuEngine:
                 theta=config.progressive_layer_drop.theta,
                 gamma=config.progressive_layer_drop.gamma,
             )
+        self.compression_masks = None
+        self._compression_cfg = None
+        cc = config.compression
+        if any(
+            (getattr(cc, f) or {}).get("shared_parameters", {}).get("enabled")
+            for f in ("weight_quantization", "sparse_pruning", "head_pruning",
+                      "row_pruning")
+        ):
+            self._compression_cfg = cc
         self.curriculum = None
         if config.data_efficiency.curriculum_learning.enabled:
             from ..data_pipeline.curriculum_scheduler import CurriculumScheduler
