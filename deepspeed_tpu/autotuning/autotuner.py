@@ -24,8 +24,11 @@ REMAT_POLICIES = ("none", "attn_mlp", "full")
 
 
 def _is_oom(err: Exception) -> bool:
+    # match XLA's OOM signatures only — a generic "hbm" substring would also
+    # swallow unrelated compiler diagnostics that merely mention the memory
+    # space, hiding the real failure from the user
     s = str(err)
-    return "RESOURCE_EXHAUSTED" in s or "Ran out of memory" in s or "hbm" in s.lower()
+    return "RESOURCE_EXHAUSTED" in s or "Ran out of memory" in s
 
 
 class Autotuner:
@@ -62,6 +65,7 @@ class Autotuner:
         cfg["train_batch_size"] = micro_batch * dp * accum
         cfg["activation_checkpointing"] = {"policy": remat}
         cfg.setdefault("steps_per_print", 10**9)
+        engine = None
         try:
             engine, *_ = deepspeed_tpu.initialize(
                 model=self.model, config=cfg, topology=self.topology
@@ -77,13 +81,15 @@ class Autotuner:
             float(engine.state.step)
             dt = (time.perf_counter() - t0) / n
             tokens = np.asarray(batch["input_ids"]).size
-            engine.destroy()
             return tokens / dt
         except Exception as e:  # noqa: BLE001 — OOM pruning is the point
             if _is_oom(e):
                 log_dist(f"autotune: mb={micro_batch} remat={remat} OOM, pruned")
                 return None
             raise
+        finally:
+            if engine is not None:
+                engine.destroy()  # release logger hooks even on failure
 
     def tune(self) -> Dict[str, Any]:
         """Returns the best config patch {micro_batch, remat_policy, throughput}."""

@@ -47,6 +47,69 @@ def weight_fake_quant(params, bits: int = 8, group_size: int = 128,
     return jax.tree_util.tree_map_with_path(q, params)
 
 
+def ste_fake_quant(params, bits: int = 8, group_size: int = 128,
+                   targets: Tuple[str, ...] = MATMUL_WEIGHTS):
+    """Straight-through-estimator fake quant for the QAT *forward*.
+
+    The forward sees quantized weights; the backward passes gradients through
+    to the full-precision masters unchanged (``round`` has zero gradient, so
+    the identity-plus-stopped-residual form is required). This is the engine
+    hook equivalent of the reference's QuantLinear.forward.
+    """
+
+    def q(path, leaf):
+        if _leaf_name(path) in targets and leaf.ndim >= 2:
+            qdq = quantize_dequantize(leaf, block=group_size, bits=bits)
+            return leaf + jax.lax.stop_gradient(qdq - leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(q, params)
+
+
+def quantization_settings(compression_config) -> Optional[Tuple[int, int]]:
+    """(bits, group_size) when weight_quantization is enabled, else None.
+
+    Per-group bit widths are collapsed to the minimum (the stacked [L, ...]
+    layout quantizes all layers with one setting); the collapse is logged by
+    the caller paths that apply it."""
+    wq = dict(compression_config.weight_quantization or {})
+    shared = dict(wq.get("shared_parameters") or {})
+    if not shared.get("enabled"):
+        return None
+    gs = int(shared.get("group_size", shared.get("quantize_groups", 0)) or 128)
+    all_bits = [
+        int((g.get("params") or {}).get("target_bits",
+                                        (g.get("params") or {}).get("bits", 8)))
+        for g in (wq.get("different_groups") or {}).values()
+    ] or [8]
+    if len(set(all_bits)) > 1:
+        from ..utils.logging import log_dist
+
+        log_dist(
+            f"compression: per-group bit widths {sorted(set(all_bits))} not "
+            f"yet differentiated on the stacked layout; using min "
+            f"(most conservative) = {min(all_bits)}"
+        )
+    return min(all_bits), gs
+
+
+def _collapsed_ratio(section: Dict[str, Any], kind: str) -> float:
+    """One dense_ratio for a pruning section; logs per-group collapse."""
+    ratios = [
+        float((g.get("params") or {}).get("dense_ratio", 0.5))
+        for g in (section.get("different_groups") or {}).values()
+    ] or [0.5]
+    if len(set(ratios)) > 1:
+        from ..utils.logging import log_dist
+
+        log_dist(
+            f"compression: {kind} per-group dense_ratios {sorted(set(ratios))} "
+            f"not differentiated on the stacked layout; using min "
+            f"(most pruned) = {min(ratios)}"
+        )
+    return min(ratios)
+
+
 def sparse_pruning_mask(w: jax.Array, density: float) -> jax.Array:
     """Keep the top-|density| fraction by magnitude (unstructured)."""
     k = max(1, int(round(density * w.size)))
@@ -92,39 +155,28 @@ def apply_layer_reduction(params, keep_layers) -> Any:
     return out
 
 
-def init_compression(params, compression_config, model_config=None):
+def init_compression(params, compression_config, model_config=None,
+                     qat_in_forward: bool = False):
     """Apply the "compression_training" section to a param pytree.
 
     Returns (params, masks) — masks are reapplied after each optimizer step
     during compressed training (engine hook) and baked in by
-    :func:`redundancy_clean`."""
+    :func:`redundancy_clean`. With ``qat_in_forward=True`` (the engine path)
+    the init-time fake-quant is skipped: the engine applies
+    :func:`ste_fake_quant` inside each forward instead, keeping the masters
+    full-precision exactly like the reference's QuantLinear."""
     cc = compression_config
     masks: Dict[str, Any] = {}
 
-    wq = dict(cc.weight_quantization or {})
-    shared = dict(wq.get("shared_parameters") or {})
-    if shared.get("enabled"):
-        gs = int(shared.get("group_size", shared.get("quantize_groups", 0)) or 128)
-        all_bits = [
-            int((g.get("params") or {}).get("target_bits",
-                                            (g.get("params") or {}).get("bits", 8)))
-            for g in (wq.get("different_groups") or {}).values()
-        ] or [8]
-        if len(set(all_bits)) > 1:
-            from ..utils.logging import log_dist
-
-            log_dist(
-                f"compression: per-group bit widths {sorted(set(all_bits))} not "
-                f"yet differentiated on the stacked layout; using min "
-                f"(most conservative) = {min(all_bits)}"
-            )
-        params = weight_fake_quant(params, bits=min(all_bits), group_size=gs)
+    if not qat_in_forward:  # engine path resolves settings itself (one log)
+        qs = quantization_settings(cc)
+        if qs is not None:
+            bits, gs = qs
+            params = weight_fake_quant(params, bits=bits, group_size=gs)
 
     sp = dict(cc.sparse_pruning or {})
     if (sp.get("shared_parameters") or {}).get("enabled"):
-        density = 0.5
-        for group in (sp.get("different_groups") or {}).values():
-            density = float((group.get("params") or {}).get("dense_ratio", 0.5))
+        density = _collapsed_ratio(sp, "sparse_pruning")
         # stacked layout: weights are [L, in, out] (ndim>=3); [L, f] biases
         # must not be magnitude-pruned (reference prunes weights only)
         layer_masks = jax.tree_util.tree_map_with_path(
@@ -147,9 +199,7 @@ def init_compression(params, compression_config, model_config=None):
 
     hp = dict(cc.head_pruning or {})
     if (hp.get("shared_parameters") or {}).get("enabled") and model_config is not None:
-        ratio = 0.5
-        for group in (hp.get("different_groups") or {}).values():
-            ratio = float((group.get("params") or {}).get("dense_ratio", 0.5))
+        ratio = _collapsed_ratio(hp, "head_pruning")
         wo = params["layers"]["attn"]["wo"]  # [L, H*hd, d]
         mask = jnp.stack([
             head_pruning_mask(wo[l], model_config.num_heads, ratio)
@@ -163,9 +213,7 @@ def init_compression(params, compression_config, model_config=None):
 
     rp = dict(cc.row_pruning or {})
     if (rp.get("shared_parameters") or {}).get("enabled"):
-        ratio = 0.5
-        for group in (rp.get("different_groups") or {}).values():
-            ratio = float((group.get("params") or {}).get("dense_ratio", 0.5))
+        ratio = _collapsed_ratio(rp, "row_pruning")
         wi = params["layers"]["mlp"]["wi"]  # [L, d, f]
         mask = jnp.stack([row_pruning_mask(wi[l], ratio) for l in range(wi.shape[0])])
         masks["row"] = mask

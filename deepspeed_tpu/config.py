@@ -144,6 +144,12 @@ class ZeroConfig:
                 raise DeepSpeedConfigError("nvme offload requires nvme_path")
         if self.offload_param.enabled and self.stage != 3:
             raise DeepSpeedConfigError("offload_param requires ZeRO stage 3")
+        if (self.zero_quantized_weights or self.zero_quantized_gradients) and (
+            self.stage != 3
+        ):
+            raise DeepSpeedConfigError(
+                "zero_quantized_weights/gradients (ZeRO++) require stage 3"
+            )
 
 
 @dataclass

@@ -50,8 +50,22 @@ def init_inference(
     **kwargs,
 ) -> "InferenceEngine":
     """Parity: deepspeed.init_inference(model, tp_size, dtype, ...)."""
+    if kwargs:
+        log_dist(
+            f"init_inference: ignoring unsupported arguments {sorted(kwargs)} "
+            f"(reference-surface kwargs with no TPU equivalent)"
+        )
     if tensor_parallel:
         tp_size = tensor_parallel.get("tp_size", tp_size)
+    if checkpoint is not None:
+        if params is not None:
+            raise ValueError("pass either checkpoint= or params=, not both")
+        from ..runtime.checkpointing import load_params
+
+        template = jax.eval_shape(
+            lambda k: model.init(k), jax.random.PRNGKey(0)
+        )
+        params = load_params(checkpoint, template)
     if dtype in ("int8", jnp.int8):
         dtype = jnp.bfloat16
         quantize_bits = quantize_bits or 8

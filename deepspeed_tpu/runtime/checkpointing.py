@@ -219,6 +219,29 @@ def load_checkpoint(
     return path, meta.get("client_state", {})
 
 
+def load_params(load_dir: str, template, tag: Optional[str] = None):
+    """Load just the model-params component of an engine checkpoint.
+
+    ``template`` is a pytree of arrays or ShapeDtypeStructs with the target
+    structure (e.g. ``jax.eval_shape(model.init, key)``). Used by
+    ``init_inference(checkpoint=...)`` to serve trained weights without
+    constructing a training engine."""
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(
+                f"no `latest` file under {load_dir!r} — not an engine "
+                f"checkpoint directory (expected the layout written by "
+                f"save_checkpoint)"
+            )
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _tag_dir(load_dir, tag)
+    if not os.path.isdir(os.path.join(path, "params")):
+        raise FileNotFoundError(f"checkpoint {path!r} has no params component")
+    return _load_tree(template, os.path.join(path, "params"), None, True)
+
+
 def list_checkpoints(save_dir: str) -> list:
     """Sorted tags present under save_dir (numeric-aware, reference layout)."""
     if not os.path.isdir(save_dir):

@@ -176,6 +176,7 @@ class TpuEngine:
             )
         self.compression_masks = None
         self._compression_cfg = None
+        self._qat = None
         cc = config.compression
         if any(
             (getattr(cc, f) or {}).get("shared_parameters", {}).get("enabled")
@@ -183,6 +184,14 @@ class TpuEngine:
                       "row_pruning")
         ):
             self._compression_cfg = cc
+        if (cc.layer_reduction or {}).get("enabled"):
+            from ..config import DeepSpeedConfigError
+
+            raise DeepSpeedConfigError(
+                "compression.layer_reduction changes the model architecture; "
+                "apply compression.compress.apply_layer_reduction to the "
+                "params (and shrink the model config) before initialize()"
+            )
         self.curriculum = None
         if config.data_efficiency.curriculum_learning.enabled:
             from ..data_pipeline.curriculum_scheduler import CurriculumScheduler
@@ -220,6 +229,22 @@ class TpuEngine:
         self.param_specs, self.grad_specs, self.opt_leaf_specs = zero_specs(
             params_shape, tp_specs, topology, config.zero_config
         )
+        self._qgather = None
+        zc = config.zero_config
+        if zc.zero_quantized_weights or zc.zero_quantized_gradients:
+            # ZeRO++ qwZ/qgZ: explicit quantized gather replaces XLA's
+            # implicit one; its custom backward is the (quantized) grad
+            # reduce-scatter (runtime/zero/quantized.py)
+            from .zero.quantized import make_quantized_gather
+
+            self._qgather = make_quantized_gather(
+                topology,
+                self.param_specs,
+                tp_specs,
+                params_shape,
+                zc.zero_quantized_weights,
+                zc.zero_quantized_gradients,
+            )
         self.param_shardings = make_shardings(self.param_specs, topology)
         self.grad_shardings = make_shardings(self.grad_specs, topology)
         offload_opt = config.zero_config.offload_optimizer.enabled
@@ -239,6 +264,25 @@ class TpuEngine:
                     lambda k: model.init(k, dtype=jnp.float32),
                     out_shardings=self.param_shardings,
                 )(self._rng)
+            if self._compression_cfg is not None:
+                # Engine hook (reference: init_compression on module wrap):
+                # pruning masks computed once here and re-imposed after every
+                # optimizer step; weight QAT runs as STE fake-quant inside
+                # each forward (_loss_for), masters stay full precision.
+                from ..compression.compress import (
+                    init_compression,
+                    quantization_settings,
+                )
+
+                params, masks = init_compression(
+                    params,
+                    self._compression_cfg,
+                    getattr(model, "config", None),
+                    qat_in_forward=True,
+                )
+                params = jax.device_put(params, self.param_shardings)
+                self.compression_masks = masks or None
+                self._qat = quantization_settings(self._compression_cfg)
             opt_state = jax.jit(
                 self.optimizer_tx.init,
                 out_shardings=opt_state_sharding(
@@ -268,6 +312,12 @@ class TpuEngine:
 
     # ------------------------------------------------------------------ step
     def _loss_for(self, params, mb, key, scale, pld_keep=None):
+        if self._qgather is not None:
+            params = self._qgather(params)
+        if self._qat is not None:
+            from ..compression.compress import ste_fake_quant
+
+            params = ste_fake_quant(params, *self._qat)
         kw = {}
         if pld_keep is not None:
             kw["pld_keep"] = pld_keep
@@ -360,6 +410,12 @@ class TpuEngine:
 
             new_params = sel(new_params, params)
             new_opt = sel(new_opt, opt_state)
+        if self.compression_masks:
+            # re-impose pruning masks the optimizer update just violated
+            # (reference: masks enforced in every compressed forward)
+            from ..compression.compress import redundancy_clean
+
+            new_params = redundancy_clean(new_params, self.compression_masks)
         new_scale = update_loss_scale(loss_scale, overflow, cfg.fp16, self.fp16_enabled)
         # skipped steps don't advance the schedule (reference scheduler parity)
         new_step = step + jnp.where(overflow, 0, 1).astype(step.dtype)
@@ -373,6 +429,14 @@ class TpuEngine:
         return new_params, new_opt, new_scale, new_step, metrics
 
     def _eval_step(self, params, batch, rng, train: bool = False):
+        # eval sees the same weights the train step optimizes: the quantized
+        # gather (ZeRO++) and QAT fake-quant apply here too
+        if self._qgather is not None:
+            params = self._qgather(params)
+        if self._qat is not None:
+            from ..compression.compress import ste_fake_quant
+
+            params = ste_fake_quant(params, *self._qat)
         loss, metrics = self.model.loss(
             params, batch, dtype=self.compute_dtype, train=train, rng=rng,
         )

@@ -182,3 +182,70 @@ def test_hf_engine_adapter_trains():
         batch={"input_ids": np.random.RandomState(4).randint(0, 128, size=(8, 16))}
     )
     assert np.isfinite(float(loss))
+
+
+def test_engine_compression_hook(devices8):
+    """Enabling compression_training in the engine config applies masks at
+    init, keeps them enforced after optimizer steps, and runs QAT in the
+    forward (ADVICE r1: previously a silent no-op)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+
+    comm.destroy_process_group()
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "compression_training": {
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {"g1": {"params": {"dense_ratio": 0.5}}},
+            },
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {"g1": {"params": {"target_bits": 8}}},
+            },
+        },
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    assert engine.compression_masks and "sparse" in engine.compression_masks
+    assert engine._qat == (8, 128)
+    batch = {"input_ids": np.random.RandomState(0).randint(0, 64, size=(8, 16))}
+    for _ in range(3):
+        loss = engine.train_batch(batch=batch)
+    assert np.isfinite(float(loss))
+    # pruned positions stay exactly zero after optimizer updates
+    def check(wleaf, m):
+        if m is None:
+            return wleaf
+        gone = np.asarray(wleaf)[np.asarray(m) == 0]
+        assert gone.size > 0 and np.all(gone == 0.0)
+        return wleaf
+
+    jax.tree.map(
+        check,
+        engine.state.params["layers"]["mlp"],
+        engine.compression_masks["sparse"],
+        is_leaf=lambda x: x is None or hasattr(x, "ndim"),
+    )
+
+
+def test_engine_rejects_layer_reduction():
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.config import DeepSpeedConfigError
+
+    comm.destroy_process_group()
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number": 1},
+        },
+    }
+    with pytest.raises(DeepSpeedConfigError, match="layer_reduction"):
+        deepspeed_tpu.initialize(model=model, config=cfg)

@@ -1,0 +1,189 @@
+"""ZeRO++ quantized collectives (SURVEY §2.2): qwZ int8 param all-gather and
+qgZ int8 gradient reduce-scatter.
+
+Oracles: the explicit (non-quantized) gather path must be numerically
+transparent; the quantized paths must stay within int8 rounding error of the
+dense collectives and must put ~4x fewer bytes on the wire (comm-hook
+byte accounting)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.comm import collectives
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.config import DeepSpeedConfigError
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.runtime.zero.quantized import (
+    gather_dim_and_axes,
+    make_quantized_gather,
+)
+
+
+def _topo(n=8):
+    comm.destroy_process_group()
+    topo = MeshTopology(ParallelDims(dp=n), devices=jax.devices()[:n])
+    comm.set_topology(topo)
+    return topo
+
+
+def test_gather_dim_and_axes():
+    assert gather_dim_and_axes(P("dp", "tp"), P(None, "tp"), 2) == (0, ("dp",))
+    assert gather_dim_and_axes(P(None, ("dp", "fsdp")), P(), 2) == (
+        1,
+        ("dp", "fsdp"),
+    )
+    assert gather_dim_and_axes(P(None, "tp"), P(None, "tp"), 2) is None
+
+
+def _gather_fixture(topo, quant_weights, quant_grads, shape=(16, 8)):
+    w = jnp.asarray(np.random.RandomState(0).randn(*shape), jnp.float32)
+    pspec, tpspec = P("dp"), P()
+    w_sharded = jax.device_put(w, NamedSharding(topo.mesh, pspec))
+    gather = make_quantized_gather(
+        topo,
+        {"w": pspec},
+        {"w": tpspec},
+        {"w": jax.ShapeDtypeStruct(shape, jnp.float32)},
+        quant_weights,
+        quant_grads,
+    )
+    return w, w_sharded, gather
+
+
+def test_explicit_gather_exact(devices8):
+    """qw=False qg=False path is numerically transparent (no quantization)."""
+    topo = _topo()
+    w, w_sharded, gather = _gather_fixture(topo, False, False)
+    out = jax.jit(lambda p: gather(p)["w"])(({"w": w_sharded}))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(w))
+
+
+def test_quantized_gather_roundtrip(devices8):
+    """qwZ: gathered weights match within int8 rounding (amax/127 per lane)."""
+    topo = _topo()
+    w, w_sharded, gather = _gather_fixture(topo, True, False)
+    out = np.asarray(jax.jit(lambda p: gather(p)["w"])({"w": w_sharded}))
+    # per-lane tolerance: each shard chunk quantized against its own amax
+    tol = np.abs(np.asarray(w)).max() / 127.0 + 1e-6
+    assert np.abs(out - np.asarray(w)).max() <= tol
+
+
+@pytest.mark.parametrize("quant_grads", [False, True])
+def test_gather_backward_is_reduce_scatter(quant_grads, devices8):
+    """Backward of the gather == gradient reduce-scatter: grad wrt the local
+    shard equals the corresponding slice of the full upstream gradient."""
+    topo = _topo()
+    w, w_sharded, gather = _gather_fixture(topo, False, quant_grads)
+    c = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.float32)
+
+    def loss(p):
+        return jnp.sum(gather(p)["w"] * c)
+
+    g = jax.jit(jax.grad(loss))({"w": w_sharded})["w"]
+    got = np.asarray(g)
+    want = np.asarray(c)  # d(sum(w*c))/dw = c, scattered == same layout
+    if quant_grads:
+        tol = np.abs(want).max() / 127.0 + 1e-6
+        assert np.abs(got - want).max() <= tol
+    else:
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+BASE = {
+    "train_batch_size": 16,
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+    "steps_per_print": 100,
+}
+
+
+def _run(cfg_extra, steps=3, hook=None):
+    comm.destroy_process_group()
+    if hook is not None:
+        collectives.register_comm_hook(hook)
+    try:
+        engine, *_ = deepspeed_tpu.initialize(
+            model=gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+            config=dict(BASE, **cfg_extra),
+            rng=jax.random.PRNGKey(7),
+        )
+        data = {
+            "input_ids": np.random.RandomState(0).randint(0, 128, size=(16, 16))
+        }
+        return [float(engine.train_batch(batch=data)) for _ in range(steps)]
+    finally:
+        if hook is not None:
+            collectives.unregister_comm_hook(hook)
+
+
+def test_zeropp_trains_close_to_dense(devices8):
+    zero3 = {"zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 1}}
+    zeropp = {
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 1,
+            "zero_quantized_weights": True,
+            "zero_quantized_gradients": True,
+        }
+    }
+    dense = _run(zero3)
+    quant = _run(zeropp)
+    assert quant[-1] < quant[0], quant  # still learns
+    # int8-lossy but tracks the dense trajectory
+    assert abs(quant[0] - dense[0]) / dense[0] < 0.03, (dense, quant)
+    assert abs(quant[-1] - dense[-1]) / dense[-1] < 0.10, (dense, quant)
+
+
+def test_zeropp_reduces_wire_bytes(devices8):
+    records = []
+    dense_records = []
+    _run(
+        {
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 1,
+                "zero_quantized_weights": True,
+                "zero_quantized_gradients": True,
+            }
+        },
+        steps=1,
+        hook=lambda op, axis, nbytes: records.append((op, nbytes)),
+    )
+    gathers = [b for op, b in records if op == "all_gather"]
+    a2a = [b for op, b in records if op == "all_to_all"]
+    assert gathers, "quantized all-gather never recorded"
+    assert a2a, "quantized grad all-to-all never recorded"
+
+    _run(
+        {"zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 1}},
+        steps=1,
+        hook=lambda op, axis, nbytes: dense_records.append((op, nbytes)),
+    )
+    # dense path gathers implicitly (XLA) → no explicit records; compare
+    # against the fp32 leaf sizes instead: int8+scale < 1/2 of fp32 bytes
+    comm.destroy_process_group()
+    model = gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16)
+    shapes = jax.eval_shape(lambda k: model.init(k), jax.random.PRNGKey(0))
+    biggest = max(
+        int(np.prod(s.shape)) * 4 for s in jax.tree_util.tree_leaves(shapes)
+    )
+    assert max(gathers) < biggest / 2, (max(gathers), biggest)
+
+
+def test_config_rejects_quantized_below_stage3():
+    from deepspeed_tpu.config import DeepSpeedConfig
+
+    with pytest.raises(DeepSpeedConfigError, match="ZeRO\\+\\+"):
+        DeepSpeedConfig(
+            dict(
+                BASE,
+                zero_optimization={"stage": 2, "zero_quantized_weights": True},
+            )
+        )
