@@ -48,9 +48,17 @@ def _to_host(leaf) -> np.ndarray:
     extra copy."""
     if not hasattr(leaf, "sharding"):
         return np.asarray(leaf)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    kind = getattr(leaf.sharding, "memory_kind", None)
+    if kind and kind != "device":
+        # offloaded (pinned_host) leaves can't be read directly through all
+        # PJRT transports — bounce through device memory first (plain
+        # device_put: no compilation, unlike a per-leaf jitted identity)
+        dev = NamedSharding(leaf.sharding.mesh, leaf.sharding.spec)
+        leaf = jax.device_put(leaf, dev)
     if getattr(leaf, "is_fully_addressable", True):
         return np.asarray(jax.device_get(leaf))
-    from jax.sharding import NamedSharding, PartitionSpec
 
     mesh = leaf.sharding.mesh
     replicated = NamedSharding(mesh, PartitionSpec())

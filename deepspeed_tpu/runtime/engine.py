@@ -16,6 +16,7 @@ imperative forward/backward/step machinery:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -245,13 +246,46 @@ class TpuEngine:
                 zc.zero_quantized_weights,
                 zc.zero_quantized_gradients,
             )
-        self.param_shardings = make_shardings(self.param_specs, topology)
+        # ---- offload (reference: zero offload_optimizer / offload_param +
+        # swap_tensor/partitioned_optimizer_swapper) --------------------------
+        on_tpu = topology.mesh.devices.flat[0].platform == "tpu"
+        off_opt = zc.offload_optimizer
+        off_par = zc.offload_param
+        self._nvme_swapper = None
+        self._opt_memory_kind = None
+        if off_opt.device == "cpu":
+            # XLA's CPU SPMD partitioner can't annotate memory kinds, so the
+            # host-memory path is TPU-only; CPU test meshes run unoffloaded
+            self._opt_memory_kind = "pinned_host" if on_tpu else None
+        elif off_opt.device == "nvme":
+            from .swap_tensor import TensorSwapper
+
+            self._nvme_swapper = TensorSwapper(
+                os.path.join(off_opt.nvme_path, "zero_opt_swap")
+            )
+        self._param_memory_kind = (
+            "pinned_host" if (off_par.enabled and on_tpu) else None
+        )
+        if off_par.enabled and not on_tpu:
+            log_dist(
+                "offload_param: pinned_host memory kinds need the TPU "
+                "backend; this CPU mesh runs without param offload"
+            )
+        if off_par.device == "nvme":
+            log_dist(
+                "offload_param.device=nvme: params stage in pinned host "
+                "memory (disk swap applies to optimizer state via "
+                "offload_optimizer.device=nvme)"
+            )
+        self.param_shardings = make_shardings(
+            self.param_specs, topology, self._param_memory_kind
+        )
+        self._param_dev_shardings = (
+            make_shardings(self.param_specs, topology)
+            if self._param_memory_kind
+            else None
+        )
         self.grad_shardings = make_shardings(self.grad_specs, topology)
-        offload_opt = config.zero_config.offload_optimizer.enabled
-        self._opt_memory_kind = "pinned_host" if offload_opt else None
-        if offload_opt and topology.mesh.devices.flat[0].platform != "tpu":
-            # CPU test meshes have no pinned_host memory space
-            self._opt_memory_kind = None
 
         # ---- materialize state (zero.Init parity: params born sharded) -----
         with use_topology(topology):
@@ -294,10 +328,22 @@ class TpuEngine:
                 ),
             )(params)
         self.opt_shardings = jax.tree.map(lambda x: x.sharding, opt_state)
+        self._opt_dev_shardings = (
+            jax.tree.map(
+                lambda s: NamedSharding(s.mesh, s.spec), self.opt_shardings
+            )
+            if self._opt_memory_kind
+            else None
+        )
+        self._opt_treedef = jax.tree_util.tree_structure(opt_state)
         loss_scale = init_loss_scale(config.fp16, self.fp16_enabled)
         self.state = TrainState(
             params, opt_state, loss_scale, jnp.zeros((), jnp.int32)
         )
+        if self._nvme_swapper is not None:
+            # optimizer state lives on disk between steps (reference:
+            # partitioned_optimizer_swapper); swapped in around each update
+            self._swap_out_opt()
 
         self._replicated = NamedSharding(topology.mesh, P())
         self._data_iters: Dict[int, Any] = {}
@@ -311,13 +357,28 @@ class TpuEngine:
         )
 
     # ------------------------------------------------------------------ step
-    def _loss_for(self, params, mb, key, scale, pld_keep=None):
+    def _device_params(self, params):
+        """Memory staging: copy offloaded (pinned_host) params to device."""
+        if self._param_memory_kind:
+            params = jax.tree.map(
+                jax.device_put, params, self._param_dev_shardings
+            )
+        return params
+
+    def _effective_params(self, params):
+        """Differentiable staging — must run *inside* the differentiated
+        function so the ZeRO++ gather's custom VJP (gradient reduce-scatter)
+        and the QAT straight-through estimator shape the backward pass."""
         if self._qgather is not None:
             params = self._qgather(params)
         if self._qat is not None:
             from ..compression.compress import ste_fake_quant
 
             params = ste_fake_quant(params, *self._qat)
+        return params
+
+    def _loss_for(self, params, mb, key, scale, pld_keep=None):
+        params = self._effective_params(params)
         kw = {}
         if pld_keep is not None:
             kw["pld_keep"] = pld_keep
@@ -380,6 +441,14 @@ class TpuEngine:
 
     def _train_step(self, params, opt_state, loss_scale, step, batch, rng):
         cfg = self.config
+        # offloaded state: explicit copies host→device for compute; the step's
+        # out_shardings put the new state back in pinned host memory, so XLA
+        # schedules the DMA both ways around the math
+        params = self._device_params(params)
+        if self._opt_memory_kind:
+            opt_state = jax.tree.map(
+                jax.device_put, opt_state, self._opt_dev_shardings
+            )
         scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
         grads, loss = self._compute_grads(params, batch, rng, scale, step)
 
@@ -429,14 +498,8 @@ class TpuEngine:
         return new_params, new_opt, new_scale, new_step, metrics
 
     def _eval_step(self, params, batch, rng, train: bool = False):
-        # eval sees the same weights the train step optimizes: the quantized
-        # gather (ZeRO++) and QAT fake-quant apply here too
-        if self._qgather is not None:
-            params = self._qgather(params)
-        if self._qat is not None:
-            from ..compression.compress import ste_fake_quant
-
-            params = ste_fake_quant(params, *self._qat)
+        # eval sees the same weights the train step optimizes
+        params = self._effective_params(self._device_params(params))
         loss, metrics = self.model.loss(
             params, batch, dtype=self.compute_dtype, train=train, rng=rng,
         )
@@ -509,11 +572,15 @@ class TpuEngine:
                 for k, v in batch.items()
             }
         prepared = self._prepare_batch(batch)
+        if self._nvme_swapper is not None:
+            self._swap_in_opt()
         with use_topology(self.topology):
             p, o, s, st, metrics = self._jit_train(
                 *self.state.astuple(), prepared, self.next_rng()
             )
         self.state = TrainState(p, o, s, st)
+        if self._nvme_swapper is not None:
+            self._swap_out_opt()
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         self._metrics = {k: v for k, v in metrics.items()}
@@ -643,16 +710,42 @@ class TpuEngine:
     def gradient_accumulation_steps(self) -> int:
         return self.config.gradient_accumulation_steps
 
+    # ------------------------------------------------------------ NVMe swap
+    def _swap_in_opt(self):
+        """Read optimizer state back from NVMe (no-op if already resident)."""
+        if self.state.opt_state is None:
+            self.state.opt_state = self._nvme_swapper.swap_in(
+                "opt_state", self._opt_treedef, self.opt_shardings
+            )
+
+    def _swap_out_opt(self):
+        """Stream optimizer state to NVMe and release its device memory."""
+        self._nvme_swapper.swap_out("opt_state", self.state.opt_state)
+        self.state.opt_state = None
+
     # --------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         from .checkpointing import save_checkpoint as _save
 
-        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+        if self._nvme_swapper is not None:
+            self._swap_in_opt()
+        try:
+            return _save(
+                self, save_dir, tag=tag, client_state=client_state or {}
+            )
+        finally:
+            if self._nvme_swapper is not None:
+                self._swap_out_opt()  # keep "on disk between steps" invariant
 
     def load_checkpoint(self, load_dir, tag=None, strict=True):
         from .checkpointing import load_checkpoint as _load
 
-        return _load(self, load_dir, tag=tag, strict=strict)
+        if self._nvme_swapper is not None:
+            self._swap_in_opt()  # loader needs a resident template tree
+        out = _load(self, load_dir, tag=tag, strict=strict)
+        if self._nvme_swapper is not None:
+            self._swap_out_opt()
+        return out
 
     def destroy(self):
         """Parity: DeepSpeedEngine.destroy — release global hooks/writers so
@@ -665,3 +758,6 @@ class TpuEngine:
                 if hasattr(m, "close"):
                     m.close()
             self.monitor = None
+        if self._nvme_swapper is not None:
+            self._nvme_swapper.close()
+            self._nvme_swapper = None

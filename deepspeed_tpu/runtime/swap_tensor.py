@@ -32,11 +32,15 @@ class TensorSwapper:
 
     def swap_out(self, name: str, tree, blocking: bool = True) -> None:
         """Write every leaf (gathered to host) to disk asynchronously."""
+        from .checkpointing import _to_host
+
         leaves = jax.tree_util.tree_leaves(tree)
         meta = []
         reqs = []
         for i, leaf in enumerate(leaves):
-            host = np.asarray(jax.device_get(leaf))
+            # _to_host handles non-fully-addressable (multi-host sharded) and
+            # pinned_host leaves; plain device_get would raise on both
+            host = _to_host(leaf)
             meta.append({"shape": list(host.shape), "dtype": str(host.dtype)})
             reqs.append(self.aio.submit_write(self._leaf_path(name, i), host))
         self._meta[name] = {
