@@ -120,6 +120,222 @@ def scale_by_onebit_adam(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+# --------------------------------------------------------------------------
+# Wire-compressed path (reference: deepspeed/runtime/comm/nccl.py
+# compressed_allreduce). The engine feeds *stacked per-dp-member local
+# gradients* ([n, ...] sharded over the data axes); the optimizer performs
+# the entire 1-bit Adam algorithm inside one shard_map: warmup = dense pmean
+# momentum/variance; compressed = per-worker momentum + bit-packed sign/scale
+# all_to_all → server average/re-compress → all_gather, with worker AND
+# server error feedback — exactly the reference's two-hop compressed
+# all-reduce, with uint8 bit-packed payloads on the wire (32× vs fp32).
+# --------------------------------------------------------------------------
+class OneBitWireState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates  # averaged momentum (replicated)
+    nu: optax.Updates  # variance (frozen after freeze_step)
+    error: optax.Updates  # worker error feedback, [n, pad] per leaf
+    server_error: optax.Updates  # server error feedback, [n, pad/n] per leaf
+
+
+def _bitsign(x):
+    return jnp.where(x >= 0, 1.0, -1.0).astype(jnp.float32)
+
+
+def _pack_bits(x):
+    """float [m] (m % 8 == 0) → uint8 [m/8]: 1 bit per sign."""
+    b = (x >= 0).astype(jnp.int32).reshape(-1, 8)
+    w = (1 << jnp.arange(8, dtype=jnp.int32))
+    return jnp.sum(b * w, axis=1).astype(jnp.uint8)
+
+
+def _unpack_bits(p):
+    """uint8 [m/8] → float32 ±1 [m]."""
+    bits = (p[:, None].astype(jnp.int32) >> jnp.arange(8, dtype=jnp.int32)) & 1
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def _compressed_allreduce(x, e_w, e_s, axes, n):
+    """Error-compensated 1-bit average of ``x`` over mesh ``axes``.
+
+    x: [pad] local value (pad % (n*8) == 0); e_w: [pad] worker error;
+    e_s: [pad//n] server error. Returns (avg [pad], new_e_w, new_e_s).
+    Wire: one uint8 all_to_all (pad/8 bytes) + one uint8 all_gather
+    (pad/(8n) bytes) + two scalar scale gathers."""
+    from jax import lax
+
+    from ..comm import collectives
+
+    buf = x + e_w
+    scale_w = jnp.mean(jnp.abs(buf))
+    packed = _pack_bits(buf)  # [pad/8]
+    new_e_w = buf - scale_w * _bitsign(buf)
+    collectives._record("all_to_all", axes, packed)
+    pk = packed.reshape(n, -1)  # [n, chunk/8]
+    recv = lax.all_to_all(pk, axes, split_axis=0, concat_axis=0, tiled=False)
+    scales = lax.all_gather(scale_w, axes, axis=0, tiled=False)  # [n]
+    chunks = jax.vmap(_unpack_bits)(recv) * scales[:, None]  # [n, chunk]
+    server = jnp.mean(chunks, axis=0)  # my chunk, averaged over workers
+
+    sbuf = server + e_s
+    scale_s = jnp.mean(jnp.abs(sbuf))
+    spk = _pack_bits(sbuf)  # [chunk/8]
+    new_e_s = sbuf - scale_s * _bitsign(sbuf)
+    collectives._record("all_gather", axes, spk)
+    gspk = lax.all_gather(spk, axes, axis=0, tiled=False)  # [n, chunk/8]
+    gscales = lax.all_gather(scale_s, axes, axis=0, tiled=False)
+    out = (jax.vmap(_unpack_bits)(gspk) * gscales[:, None]).reshape(-1)
+    return out, new_e_w, new_e_s
+
+
+def build_onebit_wire_optimizer(name, cfg, lr_schedule, topo, axes):
+    """Full 1-bit Adam/LAMB with the compressed all-reduce on the wire.
+
+    One monolithic transformation (no optax.chain) so the state is exactly
+    OneBitWireState — the engine shards the error fields over the data axes
+    via :func:`onebit_wire_state_shardings`. ``updates`` passed to update_fn
+    must be the stacked per-member local gradients [n, ...] (the engine's
+    _compute_grads_stacked path)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    n = 1
+    for a in axes:
+        n *= topo.sizes[a]
+    b1, b2 = cfg.betas
+    eps = cfg.eps
+    wd = cfg.weight_decay
+    p = dict(cfg.params)
+    freeze_step = int(p.get("freeze_step", 100))
+    use_lamb = name == "onebitlamb"
+    ax_entry = axes if len(axes) > 1 else axes[0]
+
+    def _pad_len(numel):
+        return -(-numel // (n * 8)) * (n * 8)
+
+    def init_fn(params):
+        f32 = lambda q: jnp.zeros(q.shape, jnp.float32)
+        return OneBitWireState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(f32, params),
+            nu=jax.tree.map(f32, params),
+            error=jax.tree.map(
+                lambda q: jnp.zeros((n, _pad_len(q.size)), jnp.float32), params
+            ),
+            server_error=jax.tree.map(
+                lambda q: jnp.zeros((n, _pad_len(q.size) // n), jnp.float32),
+                params,
+            ),
+        )
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+
+        def body(g_st, mu, nu, e_w, e_s, prm, cnt):
+            # local blocks: g_st leaves [1, *shape], e_w [1, pad], e_s [1, pad/n]
+            def warm(ops):
+                g_st, mu, nu, e_w, e_s = ops
+
+                def pmean_rec(g):
+                    from ..comm import collectives
+
+                    collectives._record("all_reduce", axes, g[0])
+                    return lax.pmean(g[0], axes)
+
+                gbar = jax.tree.map(pmean_rec, g_st)
+                mu2 = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, mu, gbar)
+                nu2 = jax.tree.map(
+                    lambda v, g: b2 * v + (1 - b2) * jnp.square(g), nu, gbar
+                )
+                return mu2, nu2, e_w, e_s
+
+            def comp(ops):
+                g_st, mu, nu, e_w, e_s = ops
+
+                def one(m, g, ew, es):
+                    m_i = b1 * m + (1 - b1) * g[0]
+                    flat = m_i.reshape(-1)
+                    pad = _pad_len(flat.size)
+                    flat = jnp.pad(flat, (0, pad - flat.size))
+                    avg, ew2, es2 = _compressed_allreduce(
+                        flat, ew[0], es[0], axes, n
+                    )
+                    return (
+                        avg[: m_i.size].reshape(m_i.shape),
+                        ew2[None],
+                        es2[None],
+                    )
+
+                trip = jax.tree.map(one, mu, g_st, e_w, e_s)
+                mu2 = jax.tree.map(
+                    lambda t: t[0], trip, is_leaf=lambda t: isinstance(t, tuple)
+                )
+                ew2 = jax.tree.map(
+                    lambda t: t[1], trip, is_leaf=lambda t: isinstance(t, tuple)
+                )
+                es2 = jax.tree.map(
+                    lambda t: t[2], trip, is_leaf=lambda t: isinstance(t, tuple)
+                )
+                return mu2, nu, ew2, es2  # variance frozen in compressed phase
+
+            mu2, nu2, e_w2, e_s2 = lax.cond(
+                cnt > freeze_step, comp, warm, (g_st, mu, nu, e_w, e_s)
+            )
+            bc1 = 1 - b1 ** cnt.astype(jnp.float32)
+            bc2 = 1 - b2 ** cnt.astype(jnp.float32)
+            upd = jax.tree.map(
+                lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu2, nu2
+            )
+            if wd:
+                upd = jax.tree.map(lambda u, q: u + wd * q, upd, prm)
+            if use_lamb:
+                def trust(u, q):
+                    pn = jnp.linalg.norm(q.reshape(-1))
+                    un = jnp.linalg.norm(u.reshape(-1))
+                    ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+                    return u * ratio
+
+                upd = jax.tree.map(trust, upd, prm)
+            lr = lr_schedule(cnt - 1)
+            upd = jax.tree.map(lambda u: (-lr * u).astype(jnp.float32), upd)
+            return upd, mu2, nu2, e_w2, e_s2
+
+        run = jax.shard_map(
+            body,
+            mesh=topo.mesh,
+            in_specs=(P(ax_entry), P(), P(), P(ax_entry), P(ax_entry), P(), P()),
+            out_specs=(P(), P(), P(), P(ax_entry), P(ax_entry)),
+            axis_names=set(axes),
+            check_vma=False,
+        )
+        upd, mu2, nu2, ew2, es2 = run(
+            updates, state.mu, state.nu, state.error, state.server_error,
+            params, count,
+        )
+        return upd, OneBitWireState(count, mu2, nu2, ew2, es2)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def onebit_wire_state_shardings(state_shape, topo, axes, memory_kind=None):
+    """Sharding tree for OneBitWireState: error fields over the data axes,
+    everything else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kw = {"memory_kind": memory_kind} if memory_kind else {}
+    rep = NamedSharding(topo.mesh, P(), **kw)
+    st = NamedSharding(
+        topo.mesh, P(axes if len(axes) > 1 else axes[0]), **kw
+    )
+    return OneBitWireState(
+        count=NamedSharding(topo.mesh, P()),
+        mu=jax.tree.map(lambda _: rep, state_shape.mu),
+        nu=jax.tree.map(lambda _: rep, state_shape.nu),
+        error=jax.tree.map(lambda _: st, state_shape.error),
+        server_error=jax.tree.map(lambda _: st, state_shape.server_error),
+    )
+
+
 def build_onebit_optimizer(
     name: str, cfg, lr_schedule: Callable
 ) -> optax.GradientTransformation:

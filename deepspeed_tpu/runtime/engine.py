@@ -209,11 +209,43 @@ class TpuEngine:
             config.scheduler.type, config.scheduler.params, config.optimizer.lr
         )
         self.lr_scheduler = self.lr_schedule
-        self.optimizer_tx = (
-            optimizer
-            if isinstance(optimizer, optax.GradientTransformation)
-            else build_optimizer(config.optimizer, self.lr_schedule)
+        self._stacked_grads_axes = None
+        opt_name = (config.optimizer.type or "").lower().replace("_", "")
+        data_axes_live = tuple(
+            a for a in ("dp", "fsdp") if topology.sizes[a] > 1
         )
+        if (
+            opt_name in ("onebitadam", "onebitlamb")
+            and optimizer is None
+            and data_axes_live
+            and config.zero_config.stage <= 1
+            and config.pipeline.stages <= 1
+            and not getattr(model, "is_pipeline_module", False)
+        ):
+            # wire-compressed 1-bit path (reference: compressed_allreduce):
+            # the engine hands the optimizer stacked per-member local grads
+            # and the momentum crosses the wire bit-packed
+            from ..ops.onebit import build_onebit_wire_optimizer
+
+            self._stacked_grads_axes = data_axes_live
+            self.optimizer_tx = build_onebit_wire_optimizer(
+                opt_name, config.optimizer, self.lr_schedule, topology,
+                data_axes_live,
+            )
+            msg = (
+                f"1-bit wire compression active over {data_axes_live} "
+                f"(warmup={config.optimizer.params.get('freeze_step', 100)} "
+                f"steps, then bit-packed momentum all-reduce)"
+            )
+            if config.gradient_clipping > 0:
+                msg += "; gradient_clipping is not applied in this mode"
+            log_dist(msg)
+        else:
+            self.optimizer_tx = (
+                optimizer
+                if isinstance(optimizer, optax.GradientTransformation)
+                else build_optimizer(config.optimizer, self.lr_schedule)
+            )
 
         # ---- sharding specs -------------------------------------------------
         tp_specs = (
@@ -317,15 +349,25 @@ class TpuEngine:
                 params = jax.device_put(params, self.param_shardings)
                 self.compression_masks = masks or None
                 self._qat = quantization_settings(self._compression_cfg)
-            opt_state = jax.jit(
-                self.optimizer_tx.init,
-                out_shardings=opt_state_sharding(
+            if self._stacked_grads_axes:
+                from ..ops.onebit import onebit_wire_state_shardings
+
+                opt_out_shardings = onebit_wire_state_shardings(
+                    jax.eval_shape(self.optimizer_tx.init, params_shape),
+                    topology,
+                    self._stacked_grads_axes,
+                    self._opt_memory_kind,
+                )
+            else:
+                opt_out_shardings = opt_state_sharding(
                     self.optimizer_tx,
                     jax.eval_shape(self.optimizer_tx.init, params_shape),
                     self.opt_leaf_specs,
                     topology,
                     self._opt_memory_kind,
-                ),
+                )
+            opt_state = jax.jit(
+                self.optimizer_tx.init, out_shardings=opt_out_shardings
             )(params)
         self.opt_shardings = jax.tree.map(lambda x: x.sharding, opt_state)
         self._opt_dev_shardings = (
@@ -439,6 +481,75 @@ class TpuEngine:
         grads = jax.tree.map(lambda g: g * inv, grads)
         return grads, loss_sum / accum
 
+    def _compute_grads_stacked(self, params, batch, rng, scale, step):
+        """Per-dp-member local grads stacked on a new leading axis [n, ...]
+        (sharded over the data axes) — NO cross-member reduction. Feeds the
+        wire-compressed 1-bit optimizers, which own the (compressed)
+        reduction (ops/onebit.py build_onebit_wire_optimizer)."""
+        topo = self.topology
+        axes = self._stacked_grads_axes
+        ax_entry = axes if len(axes) > 1 else axes[0]
+        accum = self.config.gradient_accumulation_steps
+        grad_fn = jax.value_and_grad(self._loss_for, has_aux=True)
+        pld = self._pld_keep(step)
+        has_pld = pld is not None
+
+        def local_fn(params, batch, key, scale, pld_keep):
+            pk = pld_keep if has_pld else None
+            if accum == 1:
+                (_, (loss, _m)), grads = grad_fn(
+                    params,
+                    jax.tree.map(lambda x: x[0], batch),
+                    jax.random.fold_in(key, 0),
+                    scale,
+                    pk,
+                )
+                inv = 1.0 / scale
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) * inv, grads
+                )
+            else:
+                zero_grads = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params
+                )
+
+                def accum_body(carry, xs):
+                    g_acc, loss_acc = carry
+                    mb, k = xs
+                    (_, (loss, _m)), grads = grad_fn(params, mb, k, scale, pk)
+                    g_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+                    )
+                    return (g_acc, loss_acc + loss), None
+
+                keys = jax.random.split(key, accum)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    accum_body,
+                    (zero_grads, jnp.zeros((), jnp.float32)),
+                    (batch, keys),
+                )
+                inv = 1.0 / (accum * scale)
+                grads = jax.tree.map(lambda g: g * inv, grads)
+                loss = loss_sum / accum
+            loss = jax.lax.pmean(loss, axes)
+            return jax.tree.map(lambda g: g[None], grads), loss
+
+        run = jax.shard_map(
+            local_fn,
+            mesh=topo.mesh,
+            in_specs=(P(), P(None, ax_entry), P(), P(), P()),
+            out_specs=(P(ax_entry), P()),
+            axis_names=set(axes),
+            check_vma=False,
+        )
+        return run(
+            params,
+            batch,
+            rng,
+            scale,
+            pld if has_pld else jnp.zeros((), jnp.float32),
+        )
+
     def _train_step(self, params, opt_state, loss_scale, step, batch, rng):
         cfg = self.config
         # offloaded state: explicit copies host→device for compute; the step's
@@ -450,7 +561,12 @@ class TpuEngine:
                 jax.device_put, opt_state, self._opt_dev_shardings
             )
         scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
-        grads, loss = self._compute_grads(params, batch, rng, scale, step)
+        if self._stacked_grads_axes:
+            grads, loss = self._compute_grads_stacked(
+                params, batch, rng, scale, step
+            )
+        else:
+            grads, loss = self._compute_grads(params, batch, rng, scale, step)
 
         # ZeRO>=2: materialize grads sharded (psum → reduce-scatter)
         if cfg.zero_config.stage >= 2 and self.topology.world_size > 1:
@@ -463,10 +579,20 @@ class TpuEngine:
         overflow = (
             ~grads_finite(grads) if self.fp16_enabled else jnp.asarray(False)
         )
-        gnorm = global_norm(grads)
-        if cfg.gradient_clipping > 0:
-            factor = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
-            grads = jax.tree.map(lambda g: g * factor, grads)
+        if self._stacked_grads_axes:
+            # stacked locals: report sqrt(Σ_i ||g_i||²/n) ≈ mean-grad norm;
+            # clipping is not applied (reference 1-bit limitation)
+            n_members = 1
+            for a in self._stacked_grads_axes:
+                n_members *= self.topology.sizes[a]
+            gnorm = global_norm(grads) / jnp.sqrt(float(n_members))
+        else:
+            gnorm = global_norm(grads)
+            if cfg.gradient_clipping > 0:
+                factor = jnp.minimum(
+                    1.0, cfg.gradient_clipping / (gnorm + 1e-6)
+                )
+                grads = jax.tree.map(lambda g: g * factor, grads)
 
         updates, new_opt = self.optimizer_tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)

@@ -1,0 +1,128 @@
+"""Wire-compressed 1-bit Adam/LAMB (SURVEY §2.1 "error-compensated
+compressed collectives"; VERDICT r1 #6).
+
+Oracles: bit-pack/unpack roundtrip; warmup phase tracks plain AdamW;
+compressed phase still learns, keeps error-feedback state, and puts ~32×
+fewer bytes on the wire than the dense fp32 all-reduce (comm-hook
+accounting)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.comm import collectives
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.ops.onebit import OneBitWireState, _bitsign, _pack_bits, _unpack_bits
+
+
+def test_bit_pack_roundtrip():
+    x = jnp.asarray(np.random.RandomState(0).randn(64), jnp.float32)
+    packed = _pack_bits(x)
+    assert packed.dtype == jnp.uint8 and packed.shape == (8,)
+    np.testing.assert_array_equal(np.asarray(_unpack_bits(packed)),
+                                  np.asarray(_bitsign(x)))
+
+
+BASE = {
+    "train_batch_size": 16,
+    "train_micro_batch_size_per_gpu": 2,
+    "bf16": {"enabled": True},
+    "steps_per_print": 100,
+}
+
+
+def _run(opt, steps=4, hook=None, seed=0):
+    comm.destroy_process_group()
+    if hook is not None:
+        collectives.register_comm_hook(hook)
+    try:
+        engine, *_ = deepspeed_tpu.initialize(
+            model=gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+            config=dict(BASE, optimizer=opt),
+            rng=jax.random.PRNGKey(11),
+        )
+        data = {
+            "input_ids": np.random.RandomState(seed).randint(0, 128, size=(16, 16))
+        }
+        losses = [float(engine.train_batch(batch=data)) for _ in range(steps)]
+        return losses, engine
+    finally:
+        if hook is not None:
+            collectives.unregister_comm_hook(hook)
+
+
+def test_warmup_tracks_adamw(devices8):
+    """Before freeze_step the wire optimizer is exact Adam(+wd) with a dense
+    pmean — it must track plain adamw closely."""
+    dense, _ = _run({"type": "adamw", "params": {"lr": 1e-3}})
+    wire, engine = _run(
+        {"type": "OneBitAdam", "params": {"lr": 1e-3, "freeze_step": 100}}
+    )
+    assert engine._stacked_grads_axes == ("dp",)
+    assert isinstance(engine.state.opt_state, OneBitWireState)
+    np.testing.assert_allclose(wire, dense, rtol=2e-3)
+
+
+def test_compressed_phase_learns_and_keeps_error_state(devices8):
+    losses, engine = _run(
+        {"type": "OneBitAdam", "params": {"lr": 1e-3, "freeze_step": 2}},
+        steps=8,
+    )
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(losses))
+    # error feedback engaged after the phase switch
+    err_leaf = jax.tree_util.tree_leaves(engine.state.opt_state.error)[0]
+    assert float(jnp.abs(err_leaf).max()) > 0.0
+    # error leaves are stacked per-member and sharded over dp
+    assert err_leaf.shape[0] == 8
+    assert "dp" in str(err_leaf.sharding.spec)
+
+
+def test_wire_bytes_are_32x_smaller(devices8):
+    records = []
+    _run(
+        {"type": "OneBitAdam", "params": {"lr": 1e-3, "freeze_step": 2}},
+        steps=3,
+        hook=lambda op, axis, b: records.append((op, b)),
+    )
+    dense = [b for op, b in records if op == "all_reduce"]
+    packed = [b for op, b in records if op == "all_to_all"]
+    assert dense and packed
+    # per-leaf: uint8 bit-packed payload vs fp32 dense payload → 32×
+    assert max(dense) / max(packed) >= 31, (max(dense), max(packed))
+
+
+def test_onebit_lamb_wire_runs(devices8):
+    losses, engine = _run(
+        {"type": "OneBitLamb", "params": {"lr": 1e-3, "freeze_step": 2}},
+        steps=5,
+    )
+    assert losses[-1] < losses[0]
+    assert engine._stacked_grads_axes
+
+
+def test_fallback_without_data_axes():
+    """tp-only topology → no dp wire to compress → numerics-only fallback."""
+    comm.destroy_process_group()
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    topo = MeshTopology(ParallelDims(tp=8), devices=jax.devices()[:8])
+    comm.set_topology(topo)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+        config=dict(
+            BASE,
+            optimizer={"type": "OneBitAdam", "params": {"lr": 1e-3}},
+            train_batch_size=4,
+            train_micro_batch_size_per_gpu=4,
+        ),
+        topology=topo,
+    )
+    assert engine._stacked_grads_axes is None
+    loss = engine.train_batch(
+        batch={"input_ids": np.random.RandomState(0).randint(0, 128, size=(4, 16))}
+    )
+    assert np.isfinite(float(loss))
