@@ -7,6 +7,13 @@ tokens/sec/chip; MFU is reported alongside in the same JSON object.
 Model-FLOPs formula (causal decoder, fwd+bwd = 3x fwd):
   fwd flops/token = 2*N_params + 2 * L * S * d_attnio  (causal QK^T+AV ≈
   2 * 2 * S/2 * (H*hd) mults per token per layer)
+
+MFU accounting is honest: activation_checkpointing.policy is "none" (a 410M
+model at this batch fits HBM without remat), so device flops == model flops
+and the 3x-fwd formula matches what actually runs. vs_baseline compares
+against the best prior BENCH_r*.json value found next to this script (the
+driver may run bench from another cwd — r2's cwd-relative scan silently
+found nothing and pinned the ratchet at 1.0).
 """
 
 import json
@@ -14,6 +21,8 @@ import os
 import time
 
 import numpy as np
+
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def peak_flops_per_chip() -> float:
@@ -52,7 +61,9 @@ def main():
             "zero_optimization": {"stage": 0},
             "gradient_clipping": 1.0,
             "steps_per_print": 1000,
-            "activation_checkpointing": {"policy": "full"},
+            # no remat: fits HBM at this size; keeps device flops == model
+            # flops so the MFU below is the real utilization
+            "activation_checkpointing": {"policy": "none"},
         },
     )
     data = {"input_ids": np.random.RandomState(0).randint(0, 32768, size=(B, S))}
@@ -73,20 +84,43 @@ def main():
     n_params = model.num_params()
     attn_flops_per_token = 2 * 2 * cfg.num_layers * (S / 2) * cfg.num_heads * cfg.hd
     fwd_flops_per_token = 2 * n_params + attn_flops_per_token
-    # fwd + bwd = 3x fwd; remat (dots_saveable) adds ~0 matmul recompute here
+    # fwd + bwd = 3x fwd; policy "none" above means no recompute, so this is
+    # exactly the device flops too
     model_flops = 3 * fwd_flops_per_token * tokens_per_step
     mfu = model_flops / dt / peak_flops_per_chip()
 
-    baseline = None
+    priors = []
     for prior in sorted(
-        f for f in os.listdir(".") if f.startswith("BENCH_r") and f.endswith(".json")
+        f
+        for f in os.listdir(REPO_DIR)
+        if f.startswith("BENCH_r") and f.endswith(".json")
     ):
         try:
-            with open(prior) as fh:
-                rec = json.load(fh)
-            baseline = rec.get("value", baseline)
+            with open(os.path.join(REPO_DIR, prior)) as fh:
+                text = fh.read()
+
+            def take(rec):
+                if isinstance(rec, dict):
+                    v = rec.get("value") or (rec.get("parsed") or {}).get("value")
+                    if isinstance(v, (int, float)):
+                        priors.append(float(v))
+
+            # driver records are one JSON object per file, but may be
+            # wrapped in a run log — scan line-wise, then fall back to a
+            # whole-file parse (pretty-printed JSON) if no line matched
+            found_before = len(priors)
+            for line in text.splitlines():
+                line = line.strip()
+                if line:
+                    try:
+                        take(json.loads(line))
+                    except ValueError:
+                        pass
+            if len(priors) == found_before:
+                take(json.loads(text))
         except Exception:
             pass
+    baseline = max(priors) if priors else None
     vs = tok_per_sec / baseline if baseline else 1.0
 
     print(

@@ -312,6 +312,35 @@ class SequenceParallelConfig:
     mode: str = "ulysses"  # ulysses | ring
 
 
+@dataclass
+class TpuKernelsConfig:
+    """TPU-native section: which Pallas kernels replace the XLA defaults.
+
+    Parity: the reference's builder/op toggles (deepspeed/ops/op_builder) —
+    where it JIT-compiles CUDA extensions, we flip registered Pallas kernels.
+    "auto" resolves to on for TPU backends, off elsewhere (kernels still run
+    under interpret=True in tests that force them on).
+    """
+
+    flash_attention: Any = AUTO  # auto | True | False
+    fused_rmsnorm: Any = False  # XLA fuses the norm chain well; opt-in
+    fused_adam: Any = False  # optax update already fuses into the step
+    flash_block_q: int = 0  # 0 => kernel default
+    flash_block_k: int = 0
+
+    def resolve(self, on_tpu: bool) -> "TpuKernelsConfig":
+        def res(v):
+            return on_tpu if v == AUTO else bool(v)
+
+        return TpuKernelsConfig(
+            flash_attention=res(self.flash_attention),
+            fused_rmsnorm=res(self.fused_rmsnorm),
+            fused_adam=res(self.fused_adam),
+            flash_block_q=int(self.flash_block_q),
+            flash_block_k=int(self.flash_block_k),
+        )
+
+
 class DeepSpeedConfig:
     """Parsed + validated ds_config. Accepts dict or json path.
 
@@ -379,6 +408,7 @@ class DeepSpeedConfig:
         if "sequence_parallel_size" in d:
             sp.setdefault("sp_size", d["sequence_parallel_size"])
         self.sequence_parallel = _parse_dc(SequenceParallelConfig, sp)
+        self.tpu_kernels = _parse_dc(TpuKernelsConfig, d.get("tpu_kernels"))
         self.flops_profiler = _parse_dc(FlopsProfilerConfig, d.get("flops_profiler"))
         self.comms_logger = _parse_dc(CommsLoggerConfig, d.get("comms_logger"))
         self.monitor = MonitorConfig(
@@ -465,6 +495,11 @@ class DeepSpeedConfig:
                 "progressive_layer_drop is not supported with pipeline "
                 "parallelism (the stochastic layer gate would have to cross "
                 "pp stage boundaries)"
+            )
+        if self.data_efficiency.random_ltd.enabled and self.pipeline.stages > 1:
+            raise DeepSpeedConfigError(
+                "random_ltd is not supported with pipeline parallelism (the "
+                "token-subset gather would cross pp stage boundaries)"
             )
         if self.sequence_parallel.mode not in ("ulysses", "ring"):
             raise DeepSpeedConfigError(

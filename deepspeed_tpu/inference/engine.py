@@ -69,6 +69,9 @@ def init_inference(
     if dtype in ("int8", jnp.int8):
         dtype = jnp.bfloat16
         quantize_bits = quantize_bits or 8
+    elif dtype == "int4":  # weight-only 4-bit (reference: quantize_bits=4)
+        dtype = jnp.bfloat16
+        quantize_bits = quantize_bits or 4
     if topology is None:
         n = tp_size if tp_size > 1 else 1
         topology = MeshTopology(

@@ -84,17 +84,35 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
         # prefill on TPU); the decode matvec below stays the einsum path
         from ..ops.attention import attention as attn_op
 
-        bias = None
-        if cfg.pos_embedding == "alibi":
-            slopes = jnp.asarray(alibi_slopes(nh))
-            rel = positions[:, None, :].astype(jnp.float32) - positions[:, :, None].astype(jnp.float32)
-            bias = slopes[None, :, None, None] * (-jnp.abs(rel))[:, None, :, :]
-        out = attn_op(q, k, v, causal=True, bias=bias)
+        # fresh-prefill positions are a contiguous arange, so ALiBi rides as
+        # slopes (in-kernel on the flash path — no [B,H,S,S] bias in HBM)
+        slopes = (
+            jnp.asarray(alibi_slopes(nh))
+            if cfg.pos_embedding == "alibi"
+            else None
+        )
+        out = attn_op(q, k, v, causal=True, alibi_slopes=slopes)
         out = out.reshape(B, S, nh * hd)
         out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
         if cfg.use_bias:
             out = out + p["bo"]
         return out, k_cache, v_cache
+    if S == 1 and cfg.pos_embedding != "alibi":
+        # fused decode path (kernel injection): Pallas cached-KV attention
+        # when the registered impl is the kernel one and shapes fit
+        from ..ops.attention import _resolve
+
+        if _resolve() == "flash":
+            from ..ops.pallas.decode_attention import decode_attention
+
+            out = decode_attention(q, k_cache, v_cache, cache_len)
+            if out is not None:
+                out = out.astype(x.dtype).reshape(B, S, nh * hd)
+                out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+                if cfg.use_bias:
+                    out = out + p["bo"]
+                return out, k_cache, v_cache
+
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if nkv != nh:

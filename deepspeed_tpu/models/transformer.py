@@ -59,6 +59,10 @@ class TransformerConfig:
     moe_capacity_factor: float = 2.0
     moe_aux_loss_coef: float = 0.01
     moe_z_loss_coef: float = 1e-3
+    # Residual-MoE (reference: deepspeed/moe/layer.py use_residual — the
+    # PR-MoE paper): a dense MLP runs alongside the routed experts and a
+    # learned 2-way per-token coefficient mixes the two outputs.
+    moe_use_residual: bool = False
     name: str = "transformer"
 
     @property
@@ -87,8 +91,11 @@ class TransformerConfig:
         else:
             mlp = 2 * d * self.ffn
         if self.is_moe:
+            dense_mlp = mlp
             mlp *= self.num_experts
             mlp += d * self.num_experts  # router
+            if self.moe_use_residual:
+                mlp += dense_mlp + 2 * d  # residual dense branch + coef
         biases = 0
         if self.use_bias:
             biases += self.num_heads * self.hd + 2 * self.kv_heads * self.hd + d
@@ -154,6 +161,12 @@ def init(cfg: TransformerConfig, rng: jax.Array, dtype=jnp.float32) -> Params:
         }
         if cfg.activation == "swiglu":
             mlp["wg"] = nrm(lk[7], L, E, d, f)
+        if cfg.moe_use_residual:
+            mlp["res_wi"] = nrm(lk[8], L, d, f)
+            mlp["res_wo"] = nrm(lk[9], L, f, d, scale=out_scale)
+            if cfg.activation == "swiglu":
+                mlp["res_wg"] = nrm(lk[10], L, d, f)
+            mlp["coef"] = nrm(lk[11], L, d, 2)
     else:
         mlp = {"wi": nrm(lk[5], L, d, f), "wo": nrm(lk[6], L, f, d, scale=out_scale)}
         if cfg.activation == "swiglu":
@@ -214,7 +227,8 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
 
 
 def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array,
-               segment_ids: Optional[jax.Array]) -> jax.Array:
+               segment_ids: Optional[jax.Array],
+               pos_default: bool = True) -> jax.Array:
     from ..ops.attention import attention as attn_op
 
     B, S, d = x.shape
@@ -229,23 +243,39 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
     if cfg.pos_embedding == "rope":
         q, k = _rope(q, k, positions, cfg.rope_theta)
 
-    bias = None
+    # ALiBi rides as per-head slopes: the flash kernel and the ring path
+    # build -slope*|Δpos| from sequence indices in-kernel, so the [B,H,S,S]
+    # bias tensor is never materialized. That is only faithful when
+    # positions ARE the sequence indices (the default arange); custom or
+    # gathered positions (left padding, random-LTD subsets) take the exact
+    # dense bias computed from the real positions instead.
+    slopes = bias = None
     if cfg.pos_embedding == "alibi":
-        slopes = jnp.asarray(alibi_slopes(nh))
-        rel = positions[:, None, :].astype(jnp.float32) - positions[:, :, None].astype(jnp.float32)
-        bias = slopes[None, :, None, None] * (-jnp.abs(rel))[:, None, :, :]  # [B,H,S,S]
+        if pos_default:
+            slopes = jnp.asarray(alibi_slopes(nh))
+        else:
+            rel = positions[:, None, :].astype(jnp.float32) - positions[:, :, None].astype(jnp.float32)
+            bias = jnp.asarray(alibi_slopes(nh))[None, :, None, None] * (
+                -jnp.abs(rel)
+            )[:, None, :, :]  # [B,H,S,S]
 
     topo = current_topology()
     if topo is not None and topo.sp_size > 1:
         # sequence parallel: Ulysses all-to-all or KV ring (parallel/sequence.py)
         from ..parallel.sequence import sp_attention
 
-        out = sp_attention(q, k, v, causal=True, bias=bias, segment_ids=segment_ids)
+        out = sp_attention(
+            q, k, v, causal=True, bias=bias, segment_ids=segment_ids,
+            alibi_slopes=slopes,
+        )
     else:
         q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
         k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
         v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
-        out = attn_op(q, k, v, causal=True, bias=bias, segment_ids=segment_ids)  # [B,S,H,hd]
+        out = attn_op(
+            q, k, v, causal=True, bias=bias, segment_ids=segment_ids,
+            alibi_slopes=slopes,
+        )  # [B,S,H,hd]
     out = out.reshape(B, S, nh * hd)
     out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
     if cfg.use_bias:
@@ -282,10 +312,12 @@ def _mlp(cfg: TransformerConfig, p: Params, x: jax.Array, rng: Optional[jax.Arra
 
 
 def _block(cfg: TransformerConfig, layer: Params, x: jax.Array, positions: jax.Array,
-           segment_ids: Optional[jax.Array], rng: Optional[jax.Array], train: bool):
+           segment_ids: Optional[jax.Array], rng: Optional[jax.Array], train: bool,
+           pos_default: bool = True):
     from jax.ad_checkpoint import checkpoint_name
 
-    h = _attention(cfg, layer["attn"], _norm(cfg, layer["ln1"], x), positions, segment_ids)
+    h = _attention(cfg, layer["attn"], _norm(cfg, layer["ln1"], x), positions,
+                   segment_ids, pos_default)
     h = checkpoint_name(h, "attn_out")  # selective remat anchor (attn_only)
     x = x + h
     x = constrain(x, ("dp", "fsdp"), "sp", None)
@@ -298,11 +330,22 @@ def _block(cfg: TransformerConfig, layer: Params, x: jax.Array, positions: jax.A
 
 def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
                       positions: jax.Array, segment_ids, rng, train: bool,
-                      remat_policy: Optional[str] = None, pld_keep=None):
+                      remat_policy: Optional[str] = None, pld_keep=None,
+                      ltd_keep: Optional[int] = None,
+                      ltd_layers: Optional[Tuple[int, int]] = None,
+                      pos_default: bool = True):
     """Scan the stacked layer params over the sequence of blocks.
 
     pld_keep: optional [L] per-layer keep probabilities (progressive layer
-    dropping) — a dropped layer passes its input through unchanged."""
+    dropping) — a dropped layer passes its input through unchanged.
+
+    ltd_keep/ltd_layers: random-LTD (reference: data_pipeline/data_routing/
+    basic_layer.py) — layers in the half-open range ``ltd_layers`` process a
+    random ``ltd_keep``-token subset (gather → block → scatter; dropped
+    tokens pass through). ``ltd_keep`` is static: the scheduler quantizes it
+    so distinct compiled programs stay bounded. The range must be contiguous
+    because a scan body needs one token-count shape for every layer it scans
+    — the stack is split pre/ltd/post instead."""
     num_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
     use_pld = pld_keep is not None and train
     if use_pld and rng is None:
@@ -311,32 +354,93 @@ def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
             "would fold the same zero key and the gates would be a fixed "
             "deterministic cut instead of per-layer/per-step sampling)"
         )
+    use_ltd = (
+        ltd_keep is not None
+        and ltd_layers is not None
+        and train
+        and int(ltd_keep) < x.shape[1]
+    )
+    if use_ltd and rng is None:
+        raise ValueError("random_ltd needs an rng to sample token subsets")
 
-    def body(carry, inp):
+    def body(carry, inp, *, ltd: bool = False):
         x, aux = carry
         if use_pld:
             layer, key, keep_p = inp
         else:
             layer, key = inp
-        out, a = _block(cfg, layer, x, positions, segment_ids, key, train)
+        if ltd:
+            from ..data_pipeline.random_ltd import (
+                gather_tokens,
+                sample_token_subset,
+                scatter_tokens,
+            )
+
+            B, S = x.shape[:2]
+            idx = sample_token_subset(
+                jax.random.fold_in(key, 11), B, S, int(ltd_keep)
+            )
+            x_kept = gather_tokens(x, idx)
+            pos_kept = jnp.take_along_axis(positions, idx, axis=1)
+            seg_kept = (
+                jnp.take_along_axis(segment_ids, idx, axis=1)
+                if segment_ids is not None
+                else None
+            )
+            # gathered positions are no longer sequence indices: pos_default
+            # False routes ALiBi through the exact positions-derived bias
+            out_kept, a = _block(
+                cfg, layer, x_kept, pos_kept, seg_kept, key, train,
+                pos_default=False,
+            )
+            out = scatter_tokens(x, out_kept, idx)
+        else:
+            out, a = _block(cfg, layer, x, positions, segment_ids, key, train,
+                            pos_default=pos_default)
         if use_pld:
             keep = jax.random.bernoulli(jax.random.fold_in(key, 7), keep_p)
             out = jnp.where(keep, out, x)
             a = jnp.where(keep, a, 0.0)
         return (out, aux + a), None
 
+    import functools
+
+    full_body = functools.partial(body, ltd=False)
+    ltd_body = functools.partial(body, ltd=True)
     if remat_policy and remat_policy != "none":
         from ..runtime.activation_checkpointing import policy_by_name
 
-        body = jax.checkpoint(body, policy=policy_by_name(remat_policy), prevent_cse=False)
+        pol = policy_by_name(remat_policy)
+        full_body = jax.checkpoint(full_body, policy=pol, prevent_cse=False)
+        ltd_body = jax.checkpoint(ltd_body, policy=pol, prevent_cse=False)
 
     keys = (
         jax.random.split(rng, num_layers)
         if rng is not None
         else jnp.zeros((num_layers, 2), jnp.uint32)
     )
-    xs = (layers, keys, pld_keep) if use_pld else (layers, keys)
-    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), xs)
+
+    def seg_xs(lo, hi):
+        sl = lambda a: a[lo:hi]
+        parts = (jax.tree.map(sl, layers), keys[lo:hi])
+        return parts + ((pld_keep[lo:hi],) if use_pld else ())
+
+    carry = (x, jnp.zeros((), jnp.float32))
+    if use_ltd:
+        lo, hi = int(ltd_layers[0]), int(ltd_layers[1])
+        if not (0 <= lo < hi <= num_layers):
+            raise ValueError(
+                f"random_ltd layer range {ltd_layers} outside [0, {num_layers})"
+            )
+        if lo > 0:
+            carry, _ = lax.scan(full_body, carry, seg_xs(0, lo))
+        carry, _ = lax.scan(ltd_body, carry, seg_xs(lo, hi))
+        if hi < num_layers:
+            carry, _ = lax.scan(full_body, carry, seg_xs(hi, num_layers))
+        x, aux = carry
+        return x, aux
+
+    (x, aux), _ = lax.scan(full_body, carry, seg_xs(0, num_layers))
     return x, aux
 
 
@@ -394,9 +498,12 @@ def masked_ce(logits: jax.Array, labels: jax.Array, num_mb_dims: int = 0):
 def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
           dtype=jnp.bfloat16, train: bool = False, rng: Optional[jax.Array] = None,
           positions: Optional[jax.Array] = None, segment_ids=None,
-          remat_policy: Optional[str] = None, pld_keep=None) -> Tuple[jax.Array, jax.Array]:
+          remat_policy: Optional[str] = None, pld_keep=None,
+          ltd_keep: Optional[int] = None,
+          ltd_layers: Optional[Tuple[int, int]] = None) -> Tuple[jax.Array, jax.Array]:
     """Forward pass → (logits fp32 [B,S,V], moe_aux_loss)."""
     B, S = input_ids.shape
+    pos_default = positions is None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cast = lambda t: jax.tree.map(
@@ -405,7 +512,7 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
     x = embed_tokens(cfg, params, input_ids, positions, dtype)
     x, aux = apply_layer_stack(
         cfg, cast(params["layers"]), x, positions, segment_ids, rng, train,
-        remat_policy, pld_keep,
+        remat_policy, pld_keep, ltd_keep, ltd_layers, pos_default,
     )
     x = _norm(cfg, cast(params["final_norm"]), x)
     return lm_head_logits(cfg, params, x), aux
@@ -413,12 +520,15 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
 
 def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array], *,
             dtype=jnp.bfloat16, train: bool = True, rng=None,
-            remat_policy: Optional[str] = None, pld_keep=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            remat_policy: Optional[str] = None, pld_keep=None,
+            ltd_keep: Optional[int] = None,
+            ltd_layers: Optional[Tuple[int, int]] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy (fp32), labels < 0 are ignored (HF -100 style)."""
     logits, aux = apply(
         cfg, params, batch["input_ids"], dtype=dtype, train=train, rng=rng,
         segment_ids=batch.get("segment_ids"), positions=batch.get("positions"),
         remat_policy=remat_policy, pld_keep=pld_keep,
+        ltd_keep=ltd_keep, ltd_layers=ltd_layers,
     )
     ce, denom = masked_ce(logits, batch["labels"])
     total = ce + cfg.moe_aux_loss_coef * aux if cfg.is_moe else ce
@@ -465,6 +575,12 @@ def tp_partition_specs(cfg: TransformerConfig, tp_divides_kv: bool = True) -> Pa
         }
         if cfg.activation == "swiglu":
             mlp["wg"] = P(None, "ep", None, "tp")
+        if cfg.moe_use_residual:
+            mlp["res_wi"] = P(None, None, "tp")
+            mlp["res_wo"] = P(None, "tp", None)
+            if cfg.activation == "swiglu":
+                mlp["res_wg"] = P(None, None, "tp")
+            mlp["coef"] = P(None, None, None)
     else:
         mlp = {"wi": P(None, None, "tp"), "wo": P(None, "tp", None)}
         if cfg.activation == "swiglu":

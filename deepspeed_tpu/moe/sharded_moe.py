@@ -113,4 +113,25 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
 
     out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
     aux = metrics["aux_loss"] + (cfg.moe_z_loss_coef / max(cfg.moe_aux_loss_coef, 1e-9)) * metrics["z_loss"]
-    return out.reshape(B, S, D), aux
+    out = out.reshape(B, S, D)
+
+    if cfg.moe_use_residual:
+        # Residual/PR-MoE (reference: deepspeed/moe/layer.py use_residual):
+        # a dense MLP runs on every token and a learned per-token 2-way
+        # softmax coefficient mixes dense vs routed outputs — the routed
+        # branch acts as a correction on top of the always-on dense expert.
+        h = jnp.einsum("bsd,df->bsf", x, p["res_wi"])
+        if cfg.activation == "swiglu":
+            h = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, p["res_wg"])) * h
+        else:
+            h = jax.nn.gelu(h)
+        h = constrain(h, ("dp", "fsdp"), "sp", "tp")
+        dense = jnp.einsum("bsf,fd->bsd", h, p["res_wo"])
+        coef = jax.nn.softmax(
+            jnp.einsum(
+                "bsd,dc->bsc", x.astype(jnp.float32), p["coef"].astype(jnp.float32)
+            ),
+            axis=-1,
+        ).astype(x.dtype)
+        out = dense * coef[..., 0:1] + out * coef[..., 1:2]
+    return out, aux

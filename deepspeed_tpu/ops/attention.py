@@ -62,10 +62,13 @@ def get_attention_impl() -> str:
     return _CURRENT
 
 
-def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
+                  alibi_slopes=None):
     """Reference attention. q: [B,S,H,hd], k/v: [B,S,KV,hd] (GQA aware).
 
     fp32 softmax accumulation; returns [B,S,H,hd] in q.dtype.
+    ``alibi_slopes`` [H] materializes the dense -slope*|Δpos| bias here (the
+    flash kernel computes it in-kernel without the [B,H,S,S] tensor).
     """
     B, S, H, hd = q.shape
     KV = k.shape[2]
@@ -75,6 +78,11 @@ def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
         v = jnp.repeat(v, H // KV, axis=2)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if alibi_slopes is not None:
+        pos = jnp.arange(S, dtype=jnp.float32)
+        rel = -jnp.abs(pos[:, None] - pos[None, :])  # [S, S]
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        logits = logits + slopes[None, :, None, None] * rel[None, None]
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     if causal:
@@ -92,5 +100,9 @@ def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
 register_attention_impl("xla", xla_attention)
 
 
-def attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
-    return _IMPLS[_resolve()](q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)
+def attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
+              alibi_slopes=None):
+    return _IMPLS[_resolve()](
+        q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
+        alibi_slopes=alibi_slopes,
+    )

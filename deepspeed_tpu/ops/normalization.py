@@ -9,15 +9,34 @@ import jax.numpy as jnp
 from jax import lax
 
 _USE_PALLAS = False
+_scope_stack: list = []
 
 
 def enable_pallas(flag: bool = True) -> None:
+    """Process-wide default (tests); engines use the scoped form below."""
     global _USE_PALLAS
     _USE_PALLAS = flag
 
 
+class pallas_rmsnorm_scope:
+    """Scoped kernel selection (no global mutation): active while tracing an
+    engine's step, so two engines with different tpu_kernels configs don't
+    fight — same pattern as ops.attention.attention_impl."""
+
+    def __init__(self, flag: bool):
+        self.flag = bool(flag)
+
+    def __enter__(self):
+        _scope_stack.append(self.flag)
+        return self
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+
+
 def rmsnorm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
-    if _USE_PALLAS:
+    use_pallas = _scope_stack[-1] if _scope_stack else _USE_PALLAS
+    if use_pallas:
         from .pallas.rmsnorm import rmsnorm as pallas_rmsnorm
 
         return pallas_rmsnorm(x, scale, eps)

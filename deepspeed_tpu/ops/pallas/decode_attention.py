@@ -1,0 +1,177 @@
+"""Pallas cached-KV decode attention (single-token step).
+
+Parity: csrc/transformer/inference attention kernels (the latency-critical
+decode matvec). The XLA fallback (models/decoding.py) expands the GQA cache
+to fp32 [B,Smax,H,hd] every step; this kernel streams the cache in its
+storage dtype, one [block_s, hd] tile per grid step, with fp32 online
+softmax in VMEM and per-tile predication that skips blocks beyond the
+current cache length — so a 64-token cache in a 4096-slot buffer does 1/64
+of the work.
+
+Layouts: q [B, KV, G, hd] (G = H/KV query heads per cache head — the GQA
+group shares one cache tile), k/v cache [B, Smax, KV, hd] (the engine's
+storage layout; no transpose on the hot path). cache_len rides in SMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+NEG_INF = -1e30
+DEFAULT_BLOCK_S = 256
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, cl_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, scale, block_s):
+    si = pl.program_id(2)
+    ns = pl.num_programs(2)
+    cl = cl_ref[0, 0]  # new token's position == number of cached tokens
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = si * block_s
+
+    @pl.when(start <= cl)  # skip tiles entirely past the live cache
+    def _body():
+        q = q_ref[0, 0]  # [G, hd]
+        k = k_ref[0, :, 0, :]  # [block_s, hd] (storage dtype)
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, block_s]
+        kpos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos <= cl, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        corr = jnp.exp(m_prev - m_safe)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True), l_scr.shape
+        )
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(si == ns - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _pick_block(S: int, preferred: int) -> Optional[int]:
+    for cand in (preferred, 512, 256, 128):
+        if cand <= S and S % cand == 0:
+            return cand
+    return S if S % 8 == 0 else None
+
+
+def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
+                            block_s: int = DEFAULT_BLOCK_S,
+                            interpret: Optional[bool] = None):
+    """q [B,1,H,hd] new-token queries vs k/v_cache [B,Smax,KV,hd].
+
+    cache_len: scalar int32 — the new token's position (tokens already
+    cached). Returns [B,1,H,hd]. Caller guarantees the new token's k/v are
+    already written at ``cache_len``.
+    """
+    B, one, H, hd = q.shape
+    assert one == 1, "decode kernel is single-token"
+    Smax, KV = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    bs = _pick_block(Smax, block_s)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / (hd**0.5)
+    qg = q.reshape(B, KV, G, hd)
+    cl = jnp.reshape(cache_len, (1, 1)).astype(jnp.int32)
+    ns = Smax // bs
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_s=bs),
+        grid=(B, KV, ns),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, kv, si: (b, kv, 0, 0)),
+            pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
+            pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
+            pl.BlockSpec((1, 1), lambda b, kv, si: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, kv, si: (b, kv, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((G, LANES), jnp.float32),
+            pltpu.VMEM((G, LANES), jnp.float32),
+            pltpu.VMEM((G, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qg, k_cache, v_cache, cl)
+    return out.reshape(B, 1, H, hd)
+
+
+def decode_attention(q, k_cache, v_cache, cache_len, *,
+                     interpret: Optional[bool] = None):
+    """Shard-map-aware wrapper: cache heads over tp, batch over dp/fsdp —
+    mirrors flash_attention's serving layout. Returns None if the shapes
+    don't fit the kernel (caller falls back to the XLA matvec)."""
+    from ...models.sharding import current_topology
+
+    B, one, H, hd = q.shape
+    Smax, KV = k_cache.shape[1], k_cache.shape[2]
+    topo = current_topology()
+    distributed = topo is not None and topo.world_size > 1
+    tp = topo.tp_size if distributed else 1
+    if (
+        one != 1
+        or H % KV != 0
+        or hd % 8 != 0
+        or _pick_block(Smax, DEFAULT_BLOCK_S) is None
+        or (distributed and (H % tp != 0 or KV % tp != 0))
+        or (distributed and (H // tp) % max(KV // tp, 1) != 0)
+    ):
+        return None
+
+    if not distributed:
+        return decode_attention_kernel(
+            q, k_cache, v_cache, cache_len, interpret=interpret
+        )
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in ("dp", "fsdp") if topo.sizes[a] > 1)
+    b_ax = batch_axes if batch_axes else None
+    h_ax = "tp" if tp > 1 else None
+
+    def body(q, kc, vc, cl):
+        return decode_attention_kernel(q, kc, vc, cl, interpret=interpret)
+
+    return shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=(
+            P(b_ax, None, h_ax, None),
+            P(b_ax, None, h_ax, None),
+            P(b_ax, None, h_ax, None),
+            P(),
+        ),
+        out_specs=P(b_ax, None, h_ax, None),
+        check_vma=False,
+    )(q, k_cache, v_cache, jnp.asarray(cache_len, jnp.int32))

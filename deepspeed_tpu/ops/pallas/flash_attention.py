@@ -7,6 +7,17 @@ mapping (no materialized KV repeat), and a two-kernel backward (dq; dk/dv)
 recomputing logits from the saved logsumexp — standard FlashAttention-2
 structure on the MXU.
 
+In-kernel masking (r3):
+- **segment_ids** (packed sequences): q ids ride lane-broadcast [B,S,LANES],
+  kv ids sublane-broadcast [B,SUBLANES,S], so the [bq,bk] same-segment mask
+  is two VMEM broadcasts and never a relayout.
+- **ALiBi** (BLOOM): per-head slope in SMEM; bias = -slope*|qpos-kpos| is
+  computed from block iotas, so the [B,H,S,S] bias tensor is never
+  materialized in HBM.
+- **sp composition**: under a DS-Ulysses mesh the kernel shard_maps heads
+  over ("tp","sp") — the all-to-alls happen outside (parallel/sequence.py),
+  the kernel itself always sees full sequence.
+
 Layouts: q [B, S, H, D] (model layout); kernels run on [B, H, S, D].
 """
 
@@ -23,6 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 LANES = 128  # lse/delta broadcast across the 128-lane minor dim (TPU tiling)
+SUBLANES = 8
 NEG_INF = -1e30
 
 
@@ -31,18 +43,65 @@ def _block_visible(qi, ki, block_q, block_k):
     return qi * block_q + block_q - 1 >= ki * block_k
 
 
-def _causal_mask(s, qi, ki, block_q, block_k):
+def _mask_and_bias(s, qi, ki, block_q, block_k, *, causal, seg_q, seg_k, slope):
+    """Apply causal + segment masks and ALiBi bias to a [bq, bk] logit tile.
+
+    seg_q: [bq, 1] | None; seg_k: [1, bk] | None; slope: scalar | None."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = (qi * block_q + rows) >= (ki * block_k + cols)
-    return jnp.where(mask, s, NEG_INF)
+    qpos = qi * block_q + rows
+    kpos = ki * block_k + cols
+    if slope is not None:
+        s = s - slope * jnp.abs(qpos - kpos).astype(jnp.float32)
+    if causal:
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    if seg_q is not None:
+        s = jnp.where(seg_q == seg_k, s, NEG_INF)
+    return s
+
+
+def _parse_refs(refs, *, has_seg, has_alibi, has_mask=False):
+    """Split a kernel's (in_refs..., out_refs..., scratch...) positional refs."""
+    q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+    i = 3
+    seg_q_ref = seg_k_ref = slopes_ref = mask_ref = None
+    if has_seg:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    if has_alibi:
+        slopes_ref = refs[i]
+        i += 1
+    if has_mask:
+        mask_ref = refs[i]
+        i += 1
+    extra = refs[i:]
+    return q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, slopes_ref, mask_ref, extra
+
+
+def _run_predicate(causal_ok, mask_ref):
+    """Combine static causal block predication with the block-mask table."""
+    if mask_ref is None:
+        return causal_ok
+    return jnp.logical_and(causal_ok, mask_ref[0, 0] > 0)
+
+
+def _tile_mask_args(seg_q_ref, seg_k_ref, slopes_ref):
+    seg_q = seg_q_ref[0][:, :1] if seg_q_ref is not None else None  # [bq,1]
+    seg_k = seg_k_ref[0][:1, :] if seg_k_ref is not None else None  # [1,bk]
+    slope = slopes_ref[0, 0] if slopes_ref is not None else None
+    return seg_q, seg_k, slope
 
 
 # -----------------------------------------------------------------------------
 # forward
 # -----------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, block_q, block_k):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_seg, has_alibi,
+                has_mask=False):
+    q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, slopes_ref, mask_ref, extra = (
+        _parse_refs(refs, has_seg=has_seg, has_alibi=has_alibi,
+                    has_mask=has_mask)
+    )
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = extra
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -52,8 +111,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: skip blocks fully above the diagonal
-    should_run = _block_visible(qi, ki, block_q, block_k) if causal else True
+    # causal: skip blocks fully above the diagonal; block-sparse: skip
+    # blocks the mask table zeroes
+    should_run = _run_predicate(
+        _block_visible(qi, ki, block_q, block_k) if causal else True, mask_ref
+    )
 
     @pl.when(should_run)
     def _body():
@@ -64,13 +126,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk] fp32
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        seg_q, seg_k, slope = _tile_mask_args(seg_q_ref, seg_k_ref, slopes_ref)
+        s = _mask_and_bias(
+            s, qi, ki, block_q, block_k, causal=causal,
+            seg_q=seg_q, seg_k=seg_k, slope=slope,
+        )
 
         m_prev = m_scr[:, :1]  # [bq, 1] (lanes hold copies)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)  # [bq, bk]
-        corr = jnp.exp(m_prev - m_new)  # [bq, 1]
+        # rows with no visible key yet keep m=-inf; exp guard against inf-inf
+        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)  # [bq, bk]
+        corr = jnp.exp(m_prev - m_safe)  # [bq, 1]
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
@@ -88,24 +155,88 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+def _mask_specs(has_seg, has_alibi, block_q, block_k, *, swap_grid=False,
+                has_mask=False):
+    """BlockSpecs for the optional mask operands.
+
+    swap_grid: the dk/dv kernel's grid is (b, h, ki, qi)."""
+    qi_of = (lambda b, h, x, y: y) if swap_grid else (lambda b, h, x, y: x)
+    ki_of = (lambda b, h, x, y: x) if swap_grid else (lambda b, h, x, y: y)
+    specs = []
+    if has_seg:
+        specs.append(
+            pl.BlockSpec(
+                (1, block_q, LANES),
+                lambda b, h, x, y: (b, qi_of(b, h, x, y), 0),
+            )
+        )
+        specs.append(
+            pl.BlockSpec(
+                (1, SUBLANES, block_k),
+                lambda b, h, x, y: (b, 0, ki_of(b, h, x, y)),
+            )
+        )
+    if has_alibi:
+        specs.append(
+            pl.BlockSpec(
+                (1, 1), lambda b, h, x, y: (h, 0), memory_space=pltpu.SMEM
+            )
+        )
+    if has_mask:
+        # block-sparse mask table [nq, nk]: one SMEM scalar per tile
+        specs.append(
+            pl.BlockSpec(
+                (1, 1),
+                lambda b, h, x, y: (qi_of(b, h, x, y), ki_of(b, h, x, y)),
+                memory_space=pltpu.SMEM,
+            )
+        )
+    return specs
+
+
+def _broadcast_segment_ids(segment_ids, S):
+    """[B,S] int32 → (q-side [B,S,LANES], kv-side [B,SUBLANES,S])."""
+    seg = segment_ids.astype(jnp.int32)
+    seg_q = jax.lax.broadcast_in_dim(seg, (*seg.shape, LANES), (0, 1))
+    seg_k = jax.lax.broadcast_in_dim(seg, (seg.shape[0], SUBLANES, S), (0, 2))
+    return seg_q, seg_k
+
+
+def _flash_fwd(q, k, v, seg, slopes, mask, *, causal, scale, block_q, block_k,
+               interpret):
     B, H, S, D = q.shape
     KV = k.shape[1]
     group = H // KV
     nq, nk = pl.cdiv(S, block_q), pl.cdiv(S, block_k)
     grid = (B, H, nq, nk)
+    has_seg, has_alibi = seg is not None, slopes is not None
+    has_mask = mask is not None
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, has_seg=has_seg, has_alibi=has_alibi,
+        has_mask=has_mask,
     )
+    operands = [q, k, v]
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+    ]
+    if has_seg:
+        seg_q, seg_k = _broadcast_segment_ids(seg, S)
+        operands += [seg_q, seg_k]
+    if has_alibi:
+        operands.append(slopes.reshape(H, 1).astype(jnp.float32))
+    if has_mask:
+        operands.append(mask.astype(jnp.int32))
+    in_specs += _mask_specs(has_seg, has_alibi, block_q, block_k,
+                            has_mask=has_mask)
+
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -123,15 +254,20 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
 # -----------------------------------------------------------------------------
 # backward
 # -----------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_seg, has_alibi,
+                   has_mask=False):
+    q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, slopes_ref, mask_ref, extra = (
+        _parse_refs(refs, has_seg=has_seg, has_alibi=has_alibi,
+                    has_mask=has_mask)
+    )
+    do_ref, lse_ref, delta_ref, dq_ref, dq_scr = extra
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -139,7 +275,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    should_run = _block_visible(qi, ki, block_q, block_k) if causal else True
+    should_run = _run_predicate(
+        _block_visible(qi, ki, block_q, block_k) if causal else True, mask_ref
+    )
 
     @pl.when(should_run)
     def _body():
@@ -152,9 +290,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse)  # [bq, bk] fp32
+        seg_q, seg_k, slope = _tile_mask_args(seg_q_ref, seg_k_ref, slopes_ref)
+        s = _mask_and_bias(
+            s, qi, ki, block_q, block_k, causal=causal,
+            seg_q=seg_q, seg_k=seg_k, slope=slope,
+        )
+        p = jnp.exp(s - lse)  # [bq, bk] fp32; fully-masked rows: lse=NEG_INF→p=0…
+        p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -169,9 +311,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_seg, has_alibi,
+                    has_mask=False):
+    q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, slopes_ref, mask_ref, extra = (
+        _parse_refs(refs, has_seg=has_seg, has_alibi=has_alibi,
+                    has_mask=has_mask)
+    )
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = extra
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -180,7 +326,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    should_run = _block_visible(qi, ki, block_q, block_k) if causal else True
+    should_run = _run_predicate(
+        _block_visible(qi, ki, block_q, block_k) if causal else True, mask_ref
+    )
 
     @pl.when(should_run)
     def _body():
@@ -193,9 +341,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        seg_q, seg_k, slope = _tile_mask_args(seg_q_ref, seg_k_ref, slopes_ref)
+        s = _mask_and_bias(
+            s, qi, ki, block_q, block_k, causal=causal,
+            seg_q=seg_q, seg_k=seg_k, slope=slope,
+        )
         p = jnp.exp(s - lse)  # [bq, bk] fp32
+        p = jnp.where(s <= NEG_INF, 0.0, p)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -215,23 +367,40 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, out, lse, do, seg, slopes, mask, *, causal, scale,
+               block_q, block_k, interpret):
     B, H, S, D = q.shape
     KV = k.shape[1]
     group = H // KV
     nq, nk = pl.cdiv(S, block_q), pl.cdiv(S, block_k)
+    has_seg, has_alibi = seg is not None, slopes is not None
+    has_mask = mask is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))  # [B,H,S,LANES]
 
+    mask_operands = []
+    if has_seg:
+        seg_q, seg_k = _broadcast_segment_ids(seg, S)
+        mask_operands += [seg_q, seg_k]
+    if has_alibi:
+        mask_operands.append(slopes.reshape(H, 1).astype(jnp.float32))
+    if has_mask:
+        mask_operands.append(mask.astype(jnp.int32))
+
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, has_seg=has_seg, has_alibi=has_alibi,
+            has_mask=has_mask,
         ),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+        ]
+        + _mask_specs(has_seg, has_alibi, block_q, block_k, has_mask=has_mask)
+        + [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -243,18 +412,24 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_k, interp
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, *mask_operands, do, lse, delta)
 
     # dk/dv accumulate over q blocks *per q-head*, then GQA-sum over the group.
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, has_seg=has_seg, has_alibi=has_alibi,
+            has_mask=has_mask,
         ),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h // group, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h // group, ki, 0)),
+        ]
+        + _mask_specs(has_seg, has_alibi, block_q, block_k, swap_grid=True,
+                      has_mask=has_mask)
+        + [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -275,7 +450,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_k, interp
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, *mask_operands, do, lse, delta)
     if group > 1:
         dk = dk.reshape(B, KV, group, S, D).sum(axis=2).astype(k.dtype)
         dv = dv.reshape(B, KV, group, S, D).sum(axis=2).astype(v.dtype)
@@ -285,32 +460,40 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_k, interp
 # -----------------------------------------------------------------------------
 # public op ([B, S, H, D] layout, custom vjp)
 # -----------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhsd(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_attention_bhsd(q, k, v, seg, slopes, mask, causal, scale, block_q,
+                          block_k, interpret):
     out, _ = _flash_fwd(
-        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        q, k, v, seg, slopes, mask, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fa_fwd(q, k, v, seg, slopes, mask, causal, scale, block_q, block_k,
+            interpret):
     out, lse = _flash_fwd(
-        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        q, k, v, seg, slopes, mask, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
     # store residual lse as [B,H,S] (drop the 128 redundant lane copies)
-    return out, (q, k, v, out, lse[..., 0])
+    return out, (q, k, v, seg, slopes, mask, out, lse[..., 0])
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse_s = res
+    q, k, v, seg, slopes, mask, out, lse_s = res
     lse = jnp.broadcast_to(lse_s[..., None], (*lse_s.shape, LANES))
     dq, dk, dv = _flash_bwd(
-        q, k, v, out, lse, do, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, interpret=interpret,
+        q, k, v, out, lse, do, seg, slopes, mask, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return dq, dk, dv
+    # segment ids / mask tables are integer primals: cotangent space is float0
+    import numpy as np
+
+    dseg = None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
+    dslopes = None if slopes is None else jnp.zeros_like(slopes)
+    dmask = None if mask is None else np.zeros(mask.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg, dslopes, dmask
 
 
 _flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
@@ -324,69 +507,166 @@ def _pick_block(S: int, preferred: int) -> Optional[int]:
     return None
 
 
+def set_default_block_sizes(block_q: int = 0, block_k: int = 0) -> None:
+    """Process-wide default override (sweeps/tests). Engines use the scoped
+    form below so two engines with different configs don't fight.
+
+    0 keeps the current default for that dim."""
+    global DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    if block_q:
+        DEFAULT_BLOCK_Q = int(block_q)
+    if block_k:
+        DEFAULT_BLOCK_K = int(block_k)
+
+
+_block_scope_stack: list = []
+
+
+class block_sizes_scope:
+    """Scoped tile-size override, active while an engine traces its step."""
+
+    def __init__(self, block_q: int = 0, block_k: int = 0):
+        self.sizes = (int(block_q), int(block_k))
+
+    def __enter__(self):
+        _block_scope_stack.append(self.sizes)
+        return self
+
+    def __exit__(self, *exc):
+        _block_scope_stack.pop()
+
+
 def flash_attention(
     q, k, v, *, causal: bool = True, bias=None, segment_ids=None,
-    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-    interpret: Optional[bool] = None,
+    alibi_slopes=None, block_mask=None, block_q: Optional[int] = None,
+    block_k: Optional[int] = None, interpret: Optional[bool] = None,
 ):
     """Flash attention in model layout q[B,S,H,D], k/v[B,S,KV,D] → [B,S,H,D].
 
-    Falls back to the XLA reference for cases the kernel doesn't cover
-    (bias/segment masking, cross-length attention, unaligned shapes).
-    Under an installed MeshTopology with >1 device, the kernel runs inside
-    shard_map (batch over dp/fsdp, heads over tp) — pallas_call has no GSPMD
-    partitioning rules, so without this the compiler would replicate it.
+    segment_ids [B,S] and alibi_slopes [H] are handled in-kernel. A *dense*
+    additive bias still falls back to the XLA reference (the only dense-bias
+    producer, ALiBi, now arrives as slopes), as do cross-length attention and
+    unaligned shapes. Under an installed MeshTopology with >1 device, the
+    kernel runs inside shard_map — batch over dp/fsdp, heads over tp, and
+    heads over ("tp","sp") on a DS-Ulysses mesh (pallas_call has no GSPMD
+    partitioning rules, so without this the compiler would replicate it).
     """
     from ..attention import xla_attention
     from ...models.sharding import current_topology
 
     B, S, H, D = q.shape
     KV = k.shape[2]
+    scoped = _block_scope_stack[-1] if _block_scope_stack else (0, 0)
+    if block_q is None:
+        block_q = scoped[0] or DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = scoped[1] or DEFAULT_BLOCK_K
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     topo = current_topology()
     distributed = topo is not None and topo.world_size > 1
     tp = topo.tp_size if topo is not None else 1
     sp = topo.sp_size if topo is not None else 1
-    local_H = H // tp if distributed else H
-    local_KV = max(KV // tp, 1) if distributed else KV
+    head_div = tp * sp if distributed else 1  # ulysses shards heads over both
+    local_H = H // head_div if distributed else H
+    local_KV = max(KV // head_div, 1) if distributed else KV
     bq, bk = _pick_block(S, block_q), _pick_block(S, block_k)
     unsupported = (
         bias is not None
-        or segment_ids is not None
         or k.shape[1] != S
         or bq is None
         or bk is None
         or H % KV != 0
         or D % 8 != 0
-        or (distributed and (sp > 1 or H % tp != 0 or KV % tp != 0))
+        or (distributed and (H % head_div != 0 or KV % head_div != 0))
         or (distributed and local_H % local_KV != 0)
     )
     if unsupported:
-        return xla_attention(q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)
+        if block_mask is not None:
+            # never silently drop the sparsity pattern: expand the block
+            # mask to a dense token bias for the fallback
+            import numpy as _np
+
+            bm = _np.asarray(block_mask)
+            if (
+                k.shape[1] != S
+                or S % bm.shape[0] != 0
+                or S % bm.shape[1] != 0
+            ):
+                raise ValueError(
+                    f"block_mask {bm.shape} incompatible with seq {S} on the "
+                    f"XLA fallback path"
+                )
+            tok = _np.kron(
+                bm, _np.ones((S // bm.shape[0], S // bm.shape[1]))
+            )
+            mask_bias = jnp.where(jnp.asarray(tok) > 0, 0.0, NEG_INF)[None, None]
+            bias = mask_bias if bias is None else bias + mask_bias
+        return xla_attention(
+            q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
+            alibi_slopes=alibi_slopes,
+        )
     scale = 1.0 / (D**0.5)
     qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
+    seg = segment_ids.astype(jnp.int32) if segment_ids is not None else None
+    slopes = (
+        jnp.asarray(alibi_slopes, jnp.float32)
+        if alibi_slopes is not None
+        else None
+    )
+    mask = jnp.asarray(block_mask, jnp.int32) if block_mask is not None else None
+    if mask is not None and mask.shape != (S // bq, S // bk):
+        raise ValueError(
+            f"block_mask shape {mask.shape} != (nq={S // bq}, nk={S // bk}) "
+            f"for seq {S} with blocks ({bq}, {bk})"
+        )
 
-    def kernel(qt, kt, vt):
-        return _flash_attention_bhsd(qt, kt, vt, causal, scale, bq, bk, interpret)
+    def kernel(qt, kt, vt, seg_, slopes_, mask_):
+        return _flash_attention_bhsd(
+            qt, kt, vt, seg_, slopes_, mask_, causal, scale, bq, bk, interpret
+        )
+
     if distributed:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         batch_axes = tuple(a for a in ("dp", "fsdp") if topo.sizes[a] > 1)
         b_ax = batch_axes if batch_axes else None
-        h_ax = "tp" if tp > 1 else None
+        head_axes = tuple(
+            a for a in (("tp",) if sp == 1 else ("tp", "sp"))
+            if topo.sizes[a] > 1
+        )
+        h_ax = head_axes if head_axes else None
         spec_q = P(b_ax, h_ax, None, None)
-        kernel = shard_map(
-            kernel,
+        # shard_map can't take None operands: pass dummies, re-None inside
+        s_in = seg if seg is not None else jnp.zeros((B, S), jnp.int32)
+        sl_in = slopes if slopes is not None else jnp.zeros((H,), jnp.float32)
+        m_in = mask if mask is not None else jnp.zeros((1, 1), jnp.int32)
+
+        def body(qt, kt, vt, s_, sl_, m_):
+            return kernel(
+                qt, kt, vt,
+                s_ if seg is not None else None,
+                sl_ if slopes is not None else None,
+                m_ if mask is not None else None,
+            )
+
+        out = shard_map(
+            body,
             mesh=topo.mesh,
-            in_specs=(spec_q, spec_q, spec_q),
+            in_specs=(
+                spec_q, spec_q, spec_q,
+                P(b_ax, None),  # segment ids: full sequence per shard
+                P(h_ax),  # per-head slopes follow the head sharding
+                P(None, None),  # block-mask table replicated
+            ),
             out_specs=spec_q,
             check_vma=False,
-        )
-    out = kernel(qt, kt, vt)
+        )(qt, kt, vt, s_in, sl_in, m_in)
+    else:
+        out = kernel(qt, kt, vt, seg, slopes, mask)
     return jnp.swapaxes(out, 1, 2)
 
 

@@ -1,0 +1,157 @@
+"""Block-sparse attention.
+
+Parity: csrc/sparse_attention/ + deepspeed/ops/sparse_attention/ (SparseSelfAttention,
+sparsity_config.py). The reference builds triton/CUDA block-sparse matmuls
+from a layout tensor; here the same block layout feeds the Pallas flash
+kernel's predication path (ops/pallas/flash_attention.py `block_mask`): a
+masked-off tile skips its QK^T/AV MXU work inside the one fused
+online-softmax kernel — no separate sdd/dsd/dds matmul trio needed, XLA/
+Mosaic already fuse the rest. (Tiles are still DMA'd; skipping the fetch too
+is a future double-buffering optimization — compute, not bandwidth, is what
+the sparse patterns save at these block sizes.)
+
+Patterns mirror the reference's sparsity_config classes: Fixed (local +
+periodic global), BigBird (window + global + random), BSLongformer (sliding
+window + global blocks), Dense. Layouts are per-model static numpy tables:
+one [nq, nk] 0/1 mask at kernel-block granularity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclass
+class SparsityConfig:
+    """Base: block size must equal the flash kernel's tile size."""
+
+    block: int = 128
+
+    def make_layout(self, seq_len: int) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+    def _n(self, seq_len: int) -> int:
+        if seq_len % self.block != 0:
+            raise ValueError(
+                f"seq_len {seq_len} not divisible by sparsity block {self.block}"
+            )
+        return seq_len // self.block
+
+
+@dataclass
+class DenseSparsityConfig(SparsityConfig):
+    """Parity: DenseSparsityConfig — all blocks visible (debug/reference)."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        return np.ones((n, n), np.int32)
+
+
+@dataclass
+class FixedSparsityConfig(SparsityConfig):
+    """Parity: FixedSparsityConfig — each block attends to its local window
+    of ``num_local_blocks`` and to the last ``num_global_blocks`` of every
+    preceding window (the "summary" blocks other windows expose)."""
+
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        nl, ng = self.num_local_blocks, self.num_global_blocks
+        layout = np.zeros((n, n), np.int32)
+        for qi in range(n):
+            window = qi // nl
+            layout[qi, window * nl : (window + 1) * nl] = 1  # local window
+            for w in range(window):  # global summary blocks of prior windows
+                lo = (w + 1) * nl - ng
+                layout[qi, max(lo, 0) : (w + 1) * nl] = 1
+        return layout
+
+
+@dataclass
+class BigBirdSparsityConfig(SparsityConfig):
+    """Parity: BigBirdSparsityConfig — sliding window + global + random."""
+
+    num_sliding_window_blocks: int = 3
+    num_global_blocks: int = 1
+    num_random_blocks: int = 1
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        w = self.num_sliding_window_blocks // 2
+        layout = np.zeros((n, n), np.int32)
+        for qi in range(n):
+            layout[qi, max(0, qi - w) : min(n, qi + w + 1)] = 1  # window
+        layout[:, : self.num_global_blocks] = 1  # global cols
+        layout[: self.num_global_blocks, :] = 1  # global rows
+        rng = np.random.RandomState(self.seed)
+        for qi in range(n):
+            for ki in rng.choice(n, size=min(self.num_random_blocks, n), replace=False):
+                layout[qi, ki] = 1
+        return layout
+
+
+@dataclass
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Parity: BSLongformerSparsityConfig — sliding window + chosen global
+    block indices that everyone attends to (and that attend to everyone)."""
+
+    num_sliding_window_blocks: int = 3
+    global_block_indices: List[int] = field(default_factory=lambda: [0])
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        w = self.num_sliding_window_blocks // 2
+        layout = np.zeros((n, n), np.int32)
+        for qi in range(n):
+            layout[qi, max(0, qi - w) : min(n, qi + w + 1)] = 1
+        for g in self.global_block_indices:
+            if g < n:
+                layout[:, g] = 1
+                layout[g, :] = 1
+        return layout
+
+
+def causal_trim(layout: np.ndarray) -> np.ndarray:
+    """Zero strictly-upper block diagonals (the kernel also causal-masks
+    inside diagonal blocks; this just documents the block-level layout)."""
+    return np.asarray(np.tril(np.ones_like(layout)) * layout, np.int32)
+
+
+def sparse_attention(q, k, v, config: SparsityConfig, *, causal: bool = True,
+                     segment_ids=None, alibi_slopes=None,
+                     interpret: Optional[bool] = None):
+    """Block-sparse attention in model layout q[B,S,H,D] → [B,S,H,D].
+
+    Parity surface: SparseSelfAttention.forward. The layout is built once
+    per (config, seq_len) and drives tile predication in the flash kernel.
+    """
+    from .pallas.flash_attention import flash_attention
+
+    S = q.shape[1]
+    layout = config.make_layout(S)
+    if causal:
+        layout = causal_trim(layout)
+    return flash_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        alibi_slopes=alibi_slopes, block_mask=layout,
+        block_q=config.block, block_k=config.block, interpret=interpret,
+    )
+
+
+def dense_blocksparse_reference(q, k, v, layout, block, *, causal=True):
+    """Oracle: dense attention with the block mask expanded to tokens."""
+    import jax.numpy as jnp
+
+    from .attention import xla_attention
+
+    S = q.shape[1]
+    n = S // block
+    tok_mask = np.kron(np.asarray(layout)[:n, :n], np.ones((block, block)))
+    bias = jnp.where(jnp.asarray(tok_mask) > 0, 0.0, -1e30)[None, None]
+    return xla_attention(q, k, v, causal=causal, bias=bias)

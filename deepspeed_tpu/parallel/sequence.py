@@ -57,7 +57,8 @@ def _in_manual_context() -> bool:
     )
 
 
-def ulysses_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+def ulysses_attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
+                      alibi_slopes=None):
     """DS-Ulysses: all-to-all seq->head, full-seq attention, all-to-all back.
 
     Parity: deepspeed/sequence/layer.py DistributedAttention.forward — the
@@ -70,12 +71,16 @@ def ulysses_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
     q = constrain(q, ("dp", "fsdp"), None, ("tp", "sp"), None)
     k = constrain(k, ("dp", "fsdp"), None, ("tp", "sp"), None)
     v = constrain(v, ("dp", "fsdp"), None, ("tp", "sp"), None)
-    out = attn_op(q, k, v, causal=causal, bias=bias, segment_ids=segment_ids)
+    out = attn_op(
+        q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
+        alibi_slopes=alibi_slopes,
+    )
     # back to sequence sharding for the rest of the block
     return constrain(out, ("dp", "fsdp"), "sp", "tp", None)
 
 
-def _ring_attention_local(q, k, v, seg_q, seg_k, *, causal: bool, axis: str):
+def _ring_attention_local(q, k, v, seg_q, seg_k, slopes, *, causal: bool,
+                          axis: str):
     """Online-softmax ring pass over the ``axis`` ring (inside shard_map).
 
     q/k/v: local blocks [B, S_loc, H|KV, hd]; positions are globalized from
@@ -103,6 +108,12 @@ def _ring_attention_local(q, k, v, seg_q, seg_k, *, causal: bool, axis: str):
         ke = jnp.repeat(kb, reps, axis=2) if reps > 1 else kb
         ve = jnp.repeat(vb, reps, axis=2) if reps > 1 else vb
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, ke.astype(jnp.float32)) * scale
+        if slopes is not None:
+            # ALiBi from *global* positions: exact across ring blocks
+            rel = -jnp.abs(
+                qpos[:, None].astype(jnp.float32) - kpos[None, :].astype(jnp.float32)
+            )  # [Sq, Sk]
+            logits = logits + slopes[None, :, None, None] * rel[None, None]
         valid = jnp.ones((B, 1, Sq, Sq), jnp.bool_)
         if causal:
             valid = valid & (kpos[None, None, None, :] <= qpos[None, None, :, None])
@@ -141,28 +152,39 @@ def _ring_attention_local(q, k, v, seg_q, seg_k, *, causal: bool, axis: str):
 
 
 def ring_attention(q, k, v, *, causal=True, segment_ids=None,
-                   topo=None, axis: str = "sp"):
+                   alibi_slopes=None, topo=None, axis: str = "sp"):
     """Ring attention over the sp mesh axis (q/k/v arrive seq-sharded).
 
-    q: [B, S, H, hd] global. ALiBi bias is not supported on the ring path
-    (use ulysses); RoPE is already applied upstream with global positions.
+    q: [B, S, H, hd] global. ALiBi rides as per-head slopes, applied from
+    global positions inside the ring (exact across blocks); RoPE is already
+    applied upstream with global positions.
     """
     topo = topo or current_topology()
     if topo is None or topo.sp_size == 1:
         from ..ops.attention import attention as attn_op
 
-        return attn_op(q, k, v, causal=causal, segment_ids=segment_ids)
+        return attn_op(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            alibi_slopes=alibi_slopes,
+        )
 
     has_seg = segment_ids is not None
+    has_alibi = alibi_slopes is not None
     seg = (
         segment_ids
         if has_seg
         else jnp.zeros((q.shape[0], q.shape[1]), jnp.int32)
     )
+    slopes = (
+        jnp.asarray(alibi_slopes, jnp.float32)
+        if has_alibi
+        else jnp.zeros((q.shape[2],), jnp.float32)
+    )
 
-    def body(ql, kl, vl, segl):
+    def body(ql, kl, vl, segl, sl):
         return _ring_attention_local(
-            ql, kl, vl, segl, segl if has_seg else None, causal=causal, axis=axis
+            ql, kl, vl, segl, segl if has_seg else None,
+            sl if has_alibi else None, causal=causal, axis=axis,
         )
 
     run = jax.shard_map(
@@ -173,26 +195,31 @@ def ring_attention(q, k, v, *, causal=True, segment_ids=None,
             P(None, axis, None, None),
             P(None, axis, None, None),
             P(None, axis),
+            P(None),  # slopes replicated over the ring
         ),
         out_specs=P(None, axis, None, None),
         axis_names={axis},
         check_vma=False,
     )
-    return run(q, k, v, seg)
+    return run(q, k, v, seg, slopes)
 
 
 _warned_fallback = set()
 
 
-def sp_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
+def sp_attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
+                 alibi_slopes=None):
     """Dispatch by configured SP mode; called from the model's attention
     when the installed topology has sp_size > 1."""
     mode = get_sp_mode()
     if mode == "ring":
         if bias is None and not _in_manual_context():
-            return ring_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+            return ring_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                alibi_slopes=alibi_slopes,
+            )
         reason = (
-            "attention bias (ALiBi) is unsupported on the ring path"
+            "dense attention bias is unsupported on the ring path"
             if bias is not None
             else "ring cannot nest inside the pipeline's manual shard_map"
         )
@@ -206,5 +233,6 @@ def sp_attention(q, k, v, *, causal=True, bias=None, segment_ids=None):
             )
             _warned_fallback.add(reason)
     return ulysses_attention(
-        q, k, v, causal=causal, bias=bias, segment_ids=segment_ids
+        q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
+        alibi_slopes=alibi_slopes,
     )

@@ -1,15 +1,23 @@
 """Engine checkpointing: save/load of the full training state.
 
 Parity: deepspeed/runtime/engine.py save_checkpoint/load_checkpoint +
-deepspeed/checkpoint/ (universal checkpoint). Design differences, TPU-first:
+deepspeed/checkpoint/ (universal checkpoint + checkpoint_engine sharded
+writers). Design, TPU-first:
 
-- Leaves are gathered to host and stored **unsharded** (one ``.npy`` per
-  leaf), so every checkpoint is already a "universal" checkpoint: it can be
-  loaded into any mesh shape / dp size / ZeRO stage — the load path simply
-  ``device_put``s each leaf with the *target* engine's shardings. The
-  reference needs a separate offline conversion step (ds_to_universal.py)
-  because its ZeRO shards are rank-local files; ours are sharding
-  annotations on one logical array.
+- Each leaf is written as **shard files**: every process writes only its
+  addressable shards (replica 0 of each), with the shard's global slice
+  bounds encoded in the filename (``leaf_00012.shard.128-256_0-512.npy``).
+  A ZeRO-3 70B leaf therefore never materializes unsharded on any host at
+  save time — the failure mode of r2's gather-then-np.save design.
+- Checkpoints stay **universal**: shards are rectangles of one logical
+  array, so the load path assembles whatever rectangles it finds and
+  ``device_put``s with the *target* engine's shardings — any mesh shape /
+  dp size / ZeRO stage. The reference needs an offline conversion step
+  (ds_to_universal.py) because its ZeRO shards are rank-local optimizer
+  fragments; ours are sharding annotations on one logical array.
+- Leaves are matched **by recorded pytree path**, not flat index, so
+  adding/reordering parameters between save and load maps correctly
+  (strict=False keeps current values for unmatched leaves).
 - ``latest`` tag file and ``global_step{N}`` tag directories match the
   reference's on-disk layout so downstream tooling translates directly.
 """
@@ -26,7 +34,8 @@ import numpy as np
 
 from ..utils.logging import log_dist
 
-_LEAF_FMT = "leaf_{:05d}.npy"
+_LEAF_FMT = "leaf_{:05d}.npy"  # legacy (r2) unsharded layout, still readable
+_SHARD_FMT = "leaf_{:05d}.shard.{}.npy"
 _COMPONENTS = ("params", "opt_state", "loss_scale")
 
 
@@ -79,39 +88,173 @@ def _barrier(name: str) -> None:
         multihost_utils.sync_global_devices(name)
 
 
+def _bounds_token(index, shape) -> str:
+    """Encode a shard's global slice bounds for its filename."""
+    if not shape:
+        return "0d"
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}-{stop}")
+    return "_".join(parts)
+
+
+def _parse_bounds(token: str):
+    """Filename token → tuple of slices (or () for 0-d)."""
+    if token == "0d":
+        return ()
+    return tuple(
+        slice(int(a), int(b))
+        for a, b in (p.split("-") for p in token.split("_"))
+    )
+
+
+def _device_view(leaf):
+    """Offloaded (pinned_host) leaves can't always be read through PJRT —
+    bounce to device memory first (plain device_put: no compilation)."""
+    kind = getattr(getattr(leaf, "sharding", None), "memory_kind", None)
+    if kind and kind != "device":
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(
+            leaf, NamedSharding(leaf.sharding.mesh, leaf.sharding.spec)
+        )
+    return leaf
+
+
 def _save_tree(tree, directory: str) -> Dict[str, Any]:
+    """Shard-wise save: each process writes replica-0 addressable shards.
+
+    No leaf is ever gathered unsharded (reference parity:
+    deepspeed/runtime/checkpoint_engine writes rank-local shard files)."""
+    os.makedirs(directory, exist_ok=True)
     if _is_writer():
-        os.makedirs(directory, exist_ok=True)
+        # clear the previous generation: a re-save under a different mesh
+        # writes different bounds tokens, and mixing generations would
+        # assemble corrupt arrays
+        for f in os.listdir(directory):
+            if f.startswith("leaf_") and f.endswith(".npy"):
+                os.remove(os.path.join(directory, f))
+    _barrier("save_tree_clean")
     leaves = jax.tree_util.tree_leaves(tree)
     names = _leaf_paths(tree)
     for i, leaf in enumerate(leaves):
-        host = _to_host(leaf)
-        if _is_writer():
-            np.save(os.path.join(directory, _LEAF_FMT.format(i)), host)
+        if not hasattr(leaf, "addressable_shards"):
+            if _is_writer():  # host scalars/np arrays: tiny, process 0 only
+                arr = np.asarray(leaf)
+                token = _bounds_token(
+                    tuple(slice(0, d) for d in arr.shape), arr.shape
+                )
+                np.save(
+                    os.path.join(directory, _SHARD_FMT.format(i, token)), arr
+                )
+            continue
+        leaf = _device_view(leaf)
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one global writer per distinct shard
+            token = _bounds_token(shard.index, leaf.shape)
+            np.save(
+                os.path.join(directory, _SHARD_FMT.format(i, token)),
+                np.asarray(shard.data),
+            )
     return {"num_leaves": len(leaves), "leaf_names": names}
 
 
-def _load_tree(template, directory: str, shardings=None, strict: bool = True):
-    leaves = jax.tree_util.tree_leaves(template)
+def _index_shard_files(directory: str) -> Dict[int, list]:
+    """Map stored leaf index → [(bounds, path)] for both layouts."""
+    out: Dict[int, list] = {}
+    if not os.path.isdir(directory):
+        return out
+    shard_re = re.compile(r"^leaf_(\d{5})\.shard\.([0-9d_\-]+)\.npy$")
+    legacy_re = re.compile(r"^leaf_(\d{5})\.npy$")
+    for f in os.listdir(directory):
+        m = shard_re.match(f)
+        if m:
+            out.setdefault(int(m.group(1)), []).append(
+                (_parse_bounds(m.group(2)), os.path.join(directory, f))
+            )
+            continue
+        m = legacy_re.match(f)
+        if m:  # r2 unsharded layout: one full-array file
+            out.setdefault(int(m.group(1)), []).append(
+                (None, os.path.join(directory, f))
+            )
+    return out
+
+
+def _assemble_leaf(entries):
+    """Read shard files into one host array (None bounds = full array)."""
+    if any(b is None for b, _ in entries):
+        if len(entries) > 1:  # legacy full-array file mixed with shards
+            raise ValueError(
+                f"corrupt checkpoint: legacy and shard files coexist for one "
+                f"leaf: {[p for _, p in entries]}"
+            )
+        return np.load(entries[0][1])
+    first = np.load(entries[0][1])
+    if not entries[0][0]:  # 0-d
+        return first
+    # global shape = max stop over shards per dim
+    ndim = first.ndim
+    shape = [0] * ndim
+    for bounds, _ in entries:
+        for d, sl in enumerate(bounds):
+            shape[d] = max(shape[d], sl.stop)
+    out = np.empty(shape, first.dtype)
+    covered = 0
+    for bounds, path in entries:
+        piece = np.load(path)
+        out[bounds] = piece
+        covered += piece.size
+    if covered != out.size:  # GSPMD shards are disjoint → sizes must tile
+        raise ValueError(
+            f"corrupt checkpoint: shards cover {covered} of {out.size} "
+            f"elements for {entries[0][1].rsplit('.shard.', 1)[0]} (missing "
+            f"or duplicated shard files — partial save?)"
+        )
+    return out
+
+
+def _load_tree(template, directory: str, shardings=None, strict: bool = True,
+               stored_names=None):
+    """Rebuild the tree from shard files, matching leaves by recorded pytree
+    path (``stored_names`` from metadata) with flat-index fallback for
+    checkpoints that predate name metadata."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(template)
+    names = [jax.tree_util.keystr(path) for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    files = _index_shard_files(directory)
+    if stored_names and len(stored_names) == len(set(stored_names)):
+        name_to_stored = {n: i for i, n in enumerate(stored_names)}
+    else:
+        name_to_stored = {n: i for i, n in enumerate(names)}  # positional
+
     loaded = []
-    for i, old in enumerate(leaves):
-        fname = os.path.join(directory, _LEAF_FMT.format(i))
-        if not os.path.exists(fname):
+    for i, (name, old) in enumerate(zip(names, leaves)):
+        stored_i = name_to_stored.get(name)
+        entries = files.get(stored_i) if stored_i is not None else None
+        if not entries:
             if strict:
-                raise FileNotFoundError(f"checkpoint missing leaf file {fname}")
-            log_dist(f"strict=False: missing {fname}, keeping current value")
+                raise FileNotFoundError(
+                    f"checkpoint missing leaf {name!r} (index {stored_i}) "
+                    f"under {directory}"
+                )
+            log_dist(f"strict=False: missing leaf {name}, keeping current value")
             loaded.append(np.asarray(jax.device_get(old)))
             continue
-        new = np.load(fname)
+        new = _assemble_leaf(entries)
         if tuple(old.shape) != tuple(new.shape):
             if strict:
                 raise ValueError(
-                    f"checkpoint leaf {i} shape {new.shape} != expected {old.shape} "
-                    f"(did the model/optimizer config change? pass strict=False "
-                    f"to keep mismatched leaves at their current values)"
+                    f"checkpoint leaf {name} shape {new.shape} != expected "
+                    f"{old.shape} (did the model/optimizer config change? pass "
+                    f"strict=False to keep mismatched leaves at their current "
+                    f"values)"
                 )
             log_dist(
-                f"strict=False: leaf {i} shape {new.shape} != {old.shape}, "
+                f"strict=False: leaf {name} shape {new.shape} != {old.shape}, "
                 f"keeping current value"
             )
             new = np.asarray(jax.device_get(old))
@@ -198,17 +341,24 @@ def load_checkpoint(
         meta = json.load(f)
 
     state = engine.state
+
+    def stored_names(component):
+        return (meta.get("components", {}).get(component) or {}).get("leaf_names")
+
     params = _load_tree(
-        state.params, os.path.join(path, "params"), engine.param_shardings, strict
+        state.params, os.path.join(path, "params"), engine.param_shardings,
+        strict, stored_names("params"),
     )
     opt_state = _load_tree(
-        state.opt_state, os.path.join(path, "opt_state"), engine.opt_shardings, strict
+        state.opt_state, os.path.join(path, "opt_state"), engine.opt_shardings,
+        strict, stored_names("opt_state"),
     )
     loss_scale = _load_tree(
         state.loss_scale,
         os.path.join(path, "loss_scale"),
         jax.tree.map(lambda _: engine._replicated, state.loss_scale),
         strict,
+        stored_names("loss_scale"),
     )
 
     import jax.numpy as jnp
@@ -247,7 +397,14 @@ def load_params(load_dir: str, template, tag: Optional[str] = None):
     path = _tag_dir(load_dir, tag)
     if not os.path.isdir(os.path.join(path, "params")):
         raise FileNotFoundError(f"checkpoint {path!r} has no params component")
-    return _load_tree(template, os.path.join(path, "params"), None, True)
+    names = None
+    meta_path = os.path.join(path, "metadata.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            names = (
+                json.load(f).get("components", {}).get("params") or {}
+            ).get("leaf_names")
+    return _load_tree(template, os.path.join(path, "params"), None, True, names)
 
 
 def list_checkpoints(save_dir: str) -> list:

@@ -167,6 +167,13 @@ class TpuEngine:
         self.fp16_enabled = config.fp16.enabled
         self.compute_dtype = config.compute_dtype
         self.remat_policy = config.activation_checkpointing.policy
+        on_tpu = topology.mesh.devices.flat[0].platform == "tpu"
+        # ---- TPU kernel selection (reference: op_builder CUDA-extension
+        # toggles become Pallas kernel switches). Applied as *scoped*
+        # overrides while tracing this engine's steps (_kernel_scope), so
+        # engines with different configs in one process don't fight. --------
+        tk = config.tpu_kernels.resolve(on_tpu)
+        self.tpu_kernels = tk
         self.pld = None
         if config.progressive_layer_drop.enabled:
             from .progressive_layer_drop import ProgressiveLayerDrop
@@ -200,6 +207,43 @@ class TpuEngine:
             self.curriculum = CurriculumScheduler(
                 config.data_efficiency.curriculum_learning
             )
+        self.random_ltd = None
+        self._ltd_layers = None
+        rl = config.data_efficiency.random_ltd
+        if rl.enabled:
+            # random-LTD (reference: data_pipeline/data_routing) — the
+            # scheduler quantizes the kept-token count (one compiled program
+            # per distinct value); the layer range must be contiguous because
+            # the layer scan is split pre/ltd/post (models/transformer.py)
+            from ..data_pipeline.random_ltd import RandomLTDScheduler
+
+            n_layers = getattr(getattr(model, "config", None), "num_layers", 0)
+            L = rl.total_layer_num or n_layers
+            self.random_ltd = RandomLTDScheduler(rl, total_layers=L)
+            ids = sorted(rl.random_ltd_layer_id)
+            if ids:
+                if ids != list(range(ids[0], ids[-1] + 1)):
+                    from ..config import DeepSpeedConfigError
+
+                    raise DeepSpeedConfigError(
+                        "random_ltd_layer_id must be a contiguous range on "
+                        "TPU (the layer scan is split around it); got "
+                        f"{rl.random_ltd_layer_id}"
+                    )
+                self._ltd_layers = (ids[0], ids[-1] + 1)
+            else:
+                # explicit layer_num is honored exactly (lo may be 0); the
+                # derived default keeps the first layer out of the drop set
+                if rl.random_ltd_layer_num:
+                    n_ltd = min(rl.random_ltd_layer_num, L)
+                    lo = (L - n_ltd) // 2
+                else:
+                    n_ltd = max(L - 2, 0)
+                    lo = max((L - n_ltd) // 2, 1)
+                self._ltd_layers = (lo, min(lo + n_ltd, L))
+            if self._ltd_layers[0] >= self._ltd_layers[1]:
+                self.random_ltd = None
+                self._ltd_layers = None
         if topology.sp_size > 1:
             # per-topology, so two engines with different modes don't fight
             topology.sp_mode = config.sequence_parallel.mode
@@ -244,7 +288,11 @@ class TpuEngine:
             self.optimizer_tx = (
                 optimizer
                 if isinstance(optimizer, optax.GradientTransformation)
-                else build_optimizer(config.optimizer, self.lr_schedule)
+                else build_optimizer(
+                    config.optimizer,
+                    self.lr_schedule,
+                    use_pallas_adam=tk.fused_adam,
+                )
             )
 
         # ---- sharding specs -------------------------------------------------
@@ -280,7 +328,6 @@ class TpuEngine:
             )
         # ---- offload (reference: zero offload_optimizer / offload_param +
         # swap_tensor/partitioned_optimizer_swapper) --------------------------
-        on_tpu = topology.mesh.devices.flat[0].platform == "tpu"
         off_opt = zc.offload_optimizer
         off_par = zc.offload_param
         self._nvme_swapper = None
@@ -419,20 +466,44 @@ class TpuEngine:
             params = ste_fake_quant(params, *self._qat)
         return params
 
-    def _loss_for(self, params, mb, key, scale, pld_keep=None):
+    def _kernel_scope(self):
+        """Trace-time kernel selection for this engine's tpu_kernels config
+        (scoped: no process-global mutation)."""
+        from contextlib import ExitStack
+
+        from ..ops.attention import attention_impl
+        from ..ops.normalization import pallas_rmsnorm_scope
+        from ..ops.pallas.flash_attention import block_sizes_scope
+
+        tk = self.tpu_kernels
+        stack = ExitStack()
+        stack.enter_context(
+            attention_impl("flash" if tk.flash_attention else "xla")
+        )
+        stack.enter_context(pallas_rmsnorm_scope(tk.fused_rmsnorm))
+        stack.enter_context(
+            block_sizes_scope(tk.flash_block_q, tk.flash_block_k)
+        )
+        return stack
+
+    def _loss_for(self, params, mb, key, scale, pld_keep=None, ltd_keep=None):
         params = self._effective_params(params)
         kw = {}
         if pld_keep is not None:
             kw["pld_keep"] = pld_keep
-        loss, metrics = self.model.loss(
-            params,
-            mb,
-            dtype=self.compute_dtype,
-            train=True,
-            rng=key,
-            remat_policy=self.remat_policy,
-            **kw,
-        )
+        if ltd_keep is not None and self._ltd_layers is not None:
+            kw["ltd_keep"] = ltd_keep
+            kw["ltd_layers"] = self._ltd_layers
+        with self._kernel_scope():
+            loss, metrics = self.model.loss(
+                params,
+                mb,
+                dtype=self.compute_dtype,
+                train=True,
+                rng=key,
+                remat_policy=self.remat_policy,
+                **kw,
+            )
         return loss * scale, (loss, metrics)
 
     def _pld_keep(self, step):
@@ -445,7 +516,7 @@ class TpuEngine:
             self.pld.get_theta(step), self.model.config.num_layers
         )
 
-    def _compute_grads(self, params, batch, rng, scale, step=None):
+    def _compute_grads(self, params, batch, rng, scale, step=None, ltd_keep=None):
         """(grads fp32 mean-over-microbatches, mean loss). ``batch`` has a
         leading grad-accum dim. Overridden by PipelineEngine (the pipeline
         schedule consumes all microbatches in one pipelined pass)."""
@@ -456,7 +527,8 @@ class TpuEngine:
             # fast path: no scan, no zeros-init accumulator HBM traffic
             key = jax.random.fold_in(rng, 0)
             (_, (loss, _m)), grads = grad_fn(
-                params, jax.tree.map(lambda x: x[0], batch), key, scale, pld_keep
+                params, jax.tree.map(lambda x: x[0], batch), key, scale,
+                pld_keep, ltd_keep,
             )
             inv = 1.0 / scale
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
@@ -469,7 +541,9 @@ class TpuEngine:
         def accum_body(carry, xs):
             g_acc, loss_acc = carry
             mb, key = xs
-            (_, (loss, _m)), grads = grad_fn(params, mb, key, scale, pld_keep)
+            (_, (loss, _m)), grads = grad_fn(
+                params, mb, key, scale, pld_keep, ltd_keep
+            )
             g_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), g_acc, grads)
             return (g_acc, loss_acc + loss), None
 
@@ -481,7 +555,8 @@ class TpuEngine:
         grads = jax.tree.map(lambda g: g * inv, grads)
         return grads, loss_sum / accum
 
-    def _compute_grads_stacked(self, params, batch, rng, scale, step):
+    def _compute_grads_stacked(self, params, batch, rng, scale, step,
+                               ltd_keep=None):
         """Per-dp-member local grads stacked on a new leading axis [n, ...]
         (sharded over the data axes) — NO cross-member reduction. Feeds the
         wire-compressed 1-bit optimizers, which own the (compressed)
@@ -503,6 +578,7 @@ class TpuEngine:
                     jax.random.fold_in(key, 0),
                     scale,
                     pk,
+                    ltd_keep,
                 )
                 inv = 1.0 / scale
                 grads = jax.tree.map(
@@ -516,7 +592,9 @@ class TpuEngine:
                 def accum_body(carry, xs):
                     g_acc, loss_acc = carry
                     mb, k = xs
-                    (_, (loss, _m)), grads = grad_fn(params, mb, k, scale, pk)
+                    (_, (loss, _m)), grads = grad_fn(
+                        params, mb, k, scale, pk, ltd_keep
+                    )
                     g_acc = jax.tree.map(
                         lambda a, g: a + g.astype(jnp.float32), g_acc, grads
                     )
@@ -550,7 +628,34 @@ class TpuEngine:
             pld if has_pld else jnp.zeros((), jnp.float32),
         )
 
-    def _train_step(self, params, opt_state, loss_scale, step, batch, rng):
+    def _grads_and_loss(self, params, loss_scale, step, batch, rng,
+                        ltd_keep=None):
+        """The fwd+bwd half of the step: (grads fp32, loss). Compiled
+        standalone for the NVMe-offload path so disk swap-in of the optimizer
+        state overlaps with this program's device time."""
+        cfg = self.config
+        params = self._device_params(params)
+        scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
+        if self._stacked_grads_axes:
+            grads, loss = self._compute_grads_stacked(
+                params, batch, rng, scale, step, ltd_keep
+            )
+        else:
+            grads, loss = self._compute_grads(
+                params, batch, rng, scale, step, ltd_keep
+            )
+
+        # ZeRO>=2: materialize grads sharded (psum → reduce-scatter)
+        if cfg.zero_config.stage >= 2 and self.topology.world_size > 1:
+            grads = jax.tree.map(
+                lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                grads,
+                self.grad_shardings,
+            )
+        return grads, loss
+
+    def _apply_update(self, params, opt_state, loss_scale, step, grads, loss):
+        """The optimizer half of the step (overflow skip, clip, update)."""
         cfg = self.config
         # offloaded state: explicit copies host→device for compute; the step's
         # out_shardings put the new state back in pinned host memory, so XLA
@@ -560,22 +665,6 @@ class TpuEngine:
             opt_state = jax.tree.map(
                 jax.device_put, opt_state, self._opt_dev_shardings
             )
-        scale = loss_scale.scale if self.fp16_enabled else jnp.ones((), jnp.float32)
-        if self._stacked_grads_axes:
-            grads, loss = self._compute_grads_stacked(
-                params, batch, rng, scale, step
-            )
-        else:
-            grads, loss = self._compute_grads(params, batch, rng, scale, step)
-
-        # ZeRO>=2: materialize grads sharded (psum → reduce-scatter)
-        if cfg.zero_config.stage >= 2 and self.topology.world_size > 1:
-            grads = jax.tree.map(
-                lambda g, s: jax.lax.with_sharding_constraint(g, s),
-                grads,
-                self.grad_shardings,
-            )
-
         overflow = (
             ~grads_finite(grads) if self.fp16_enabled else jnp.asarray(False)
         )
@@ -623,12 +712,20 @@ class TpuEngine:
         }
         return new_params, new_opt, new_scale, new_step, metrics
 
+    def _train_step(self, params, opt_state, loss_scale, step, batch, rng,
+                    ltd_keep=None):
+        grads, loss = self._grads_and_loss(
+            params, loss_scale, step, batch, rng, ltd_keep
+        )
+        return self._apply_update(params, opt_state, loss_scale, step, grads, loss)
+
     def _eval_step(self, params, batch, rng, train: bool = False):
         # eval sees the same weights the train step optimizes
         params = self._effective_params(self._device_params(params))
-        loss, metrics = self.model.loss(
-            params, batch, dtype=self.compute_dtype, train=train, rng=rng,
-        )
+        with self._kernel_scope():
+            loss, metrics = self.model.loss(
+                params, batch, dtype=self.compute_dtype, train=train, rng=rng,
+            )
         return loss, metrics
 
     def _compile_step_fns(self):
@@ -642,9 +739,24 @@ class TpuEngine:
         self._jit_train = jax.jit(
             self._train_step,
             donate_argnums=(0, 1, 2, 3),
+            static_argnums=(6,),  # random-LTD kept-token count
             out_shardings=(*state_shardings, None),
         )
         self._jit_eval = jax.jit(self._eval_step, static_argnums=(3,))
+        if self._nvme_swapper is not None:
+            # NVMe overlap (reference: partitioned_optimizer_swapper's
+            # async_swapper): the step splits into a grads program and an
+            # update program; train_batch dispatches grads, then does the
+            # disk swap-in while the device computes, then dispatches the
+            # update. Swap-out writes overlap the next step.
+            self._jit_grads = jax.jit(
+                self._grads_and_loss, static_argnums=(5,)
+            )
+            self._jit_update = jax.jit(
+                self._apply_update,
+                donate_argnums=(0, 1, 2, 3),
+                out_shardings=(*state_shardings, None),
+            )
 
     # ------------------------------------------------------------- batching
     def _batch_sharding(self, accum_leading: bool):
@@ -698,15 +810,35 @@ class TpuEngine:
                 for k, v in batch.items()
             }
         prepared = self._prepare_batch(batch)
-        if self._nvme_swapper is not None:
-            self._swap_in_opt()
-        with use_topology(self.topology):
-            p, o, s, st, metrics = self._jit_train(
-                *self.state.astuple(), prepared, self.next_rng()
+        ltd_keep = None
+        if self.random_ltd is not None:
+            # skipped (fp16-overflow) steps must not advance the anneal —
+            # same invariant the in-step counter enforces for lr/PLD
+            ltd_keep = self.random_ltd.get_seq_len(
+                self.global_steps - self.skipped_steps
             )
+            seq = prepared["input_ids"].shape[-1]
+            if ltd_keep >= seq:
+                ltd_keep = None  # schedule annealed past full length
+        with use_topology(self.topology):
+            if self._nvme_swapper is not None:
+                # dispatch grads async, then overlap the NVMe swap-in with
+                # the device's fwd+bwd time; the update program follows
+                grads, loss = self._jit_grads(
+                    self.state.params, self.state.loss_scale, self.state.step,
+                    prepared, self.next_rng(), ltd_keep,
+                )
+                self._swap_in_opt()
+                p, o, s, st, metrics = self._jit_update(
+                    *self.state.astuple(), grads, loss
+                )
+            else:
+                p, o, s, st, metrics = self._jit_train(
+                    *self.state.astuple(), prepared, self.next_rng(), ltd_keep
+                )
         self.state = TrainState(p, o, s, st)
         if self._nvme_swapper is not None:
-            self._swap_out_opt()
+            self._swap_out_opt(blocking=False)  # writes overlap next step
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         self._metrics = {k: v for k, v in metrics.items()}
@@ -766,6 +898,23 @@ class TpuEngine:
         with use_topology(self.topology):
             loss, _ = self._jit_eval(self.state.params, prepared, self.next_rng())
         return loss
+
+    def profile_step(self, data_iter=None, batch=None,
+                     trace_dir: str = "xprof_trace"):
+        """Run one train step under ``jax.profiler.trace`` and dump an xprof
+        trace to ``trace_dir`` (open with xprof/tensorboard, or feed to the
+        autotuner). Returns (loss, trace_dir).
+
+        Parity: the reference's flops-profiler/wall-clock breakdown hooks —
+        here the XLA profiler captures per-op device timelines instead of
+        python-side module timers (the step is one fused program)."""
+        os.makedirs(trace_dir, exist_ok=True)
+        with jax.profiler.trace(trace_dir):
+            loss = self.train_batch(data_iter=data_iter, batch=batch)
+            # host-read so the device work lands inside the trace window
+            jax.block_until_ready(self.state.params)
+        log_dist(f"profile_step: xprof trace written to {trace_dir}")
+        return loss, trace_dir
 
     # -- reference imperative protocol ---------------------------------------
     def forward(self, batch):
@@ -844,9 +993,15 @@ class TpuEngine:
                 "opt_state", self._opt_treedef, self.opt_shardings
             )
 
-    def _swap_out_opt(self):
-        """Stream optimizer state to NVMe and release its device memory."""
-        self._nvme_swapper.swap_out("opt_state", self.state.opt_state)
+    def _swap_out_opt(self, blocking: bool = True):
+        """Stream optimizer state to NVMe and release its device memory.
+
+        blocking=False leaves the disk writes in flight (the swapper blocks
+        the next swap_in on them), overlapping write I/O with host-side batch
+        prep and the next step's dispatch."""
+        self._nvme_swapper.swap_out(
+            "opt_state", self.state.opt_state, blocking=blocking
+        )
         self.state.opt_state = None
 
     # --------------------------------------------------------- checkpointing

@@ -42,17 +42,20 @@ class PipelineEngine(TpuEngine):
             )
         super().__init__(model=model, config=config, topology=topology, **kw)
 
-    def _compute_grads(self, params, batch, rng, scale, step=None):
+    def _compute_grads(self, params, batch, rng, scale, step=None,
+                       ltd_keep=None):
+        del ltd_keep  # random-LTD is not routed through the pipeline schedule
         def scaled_loss(p):
-            loss, _metrics = self.model.pipeline_loss(
-                p,
-                batch,
-                topology=self.topology,
-                dtype=self.compute_dtype,
-                train=True,
-                rng=rng,
-                remat_policy=self.remat_policy,
-            )
+            with self._kernel_scope():  # tpu_kernels applies to pp steps too
+                loss, _metrics = self.model.pipeline_loss(
+                    p,
+                    batch,
+                    topology=self.topology,
+                    dtype=self.compute_dtype,
+                    train=True,
+                    rng=rng,
+                    remat_policy=self.remat_policy,
+                )
             return loss * scale, loss
 
         (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)

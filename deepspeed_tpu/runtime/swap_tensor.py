@@ -26,21 +26,43 @@ class TensorSwapper:
         os.makedirs(swap_dir, exist_ok=True)
         self.aio = AsyncIOHandle(num_threads=num_threads)
         self._meta: Dict[str, Any] = {}
+        # in-flight write requests per name, plus the host buffers they read
+        # from (kept alive until the write completes)
+        self._pending: Dict[str, Any] = {}
 
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.leaf{i}.bin")
 
+    def wait_pending(self, name: str) -> None:
+        """Block until any in-flight writes for ``name`` have hit disk."""
+        reqs, _bufs = self._pending.pop(name, ([], None))
+        for r in reqs:
+            self.aio.wait(r)
+
     def swap_out(self, name: str, tree, blocking: bool = True) -> None:
-        """Write every leaf (gathered to host) to disk asynchronously."""
+        """Write every leaf (gathered to host) to disk asynchronously.
+
+        blocking=False returns as soon as the writes are enqueued; the next
+        swap_in/wait_pending for this name blocks on them (read-after-write).
+        Device→host transfers are pipelined via copy_to_host_async."""
         from .checkpointing import _to_host
 
+        self.wait_pending(name)  # don't interleave two write generations
         leaves = jax.tree_util.tree_leaves(tree)
+        for leaf in leaves:  # start all D2H copies before draining any
+            if hasattr(leaf, "copy_to_host_async"):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    pass  # pinned-host/odd transports: _to_host still works
         meta = []
         reqs = []
+        hosts = []
         for i, leaf in enumerate(leaves):
             # _to_host handles non-fully-addressable (multi-host sharded) and
             # pinned_host leaves; plain device_get would raise on both
             host = _to_host(leaf)
+            hosts.append(host)
             meta.append({"shape": list(host.shape), "dtype": str(host.dtype)})
             reqs.append(self.aio.submit_write(self._leaf_path(name, i), host))
         self._meta[name] = {
@@ -52,9 +74,12 @@ class TensorSwapper:
         if blocking:
             for r in reqs:
                 self.aio.wait(r)
+        else:
+            self._pending[name] = (reqs, hosts)
 
     def swap_in(self, name: str, treedef=None, shardings=None):
         """Read leaves back; returns the reconstructed pytree."""
+        self.wait_pending(name)
         meta = self._meta.get(name)
         if meta is None:
             with open(os.path.join(self.swap_dir, f"{name}.json")) as f:
@@ -75,6 +100,7 @@ class TensorSwapper:
         return tree
 
     def release(self, name: str) -> None:
+        self.wait_pending(name)
         meta = self._meta.pop(name, None)
         if meta:
             for i in range(len(meta["leaves"])):
@@ -84,4 +110,6 @@ class TensorSwapper:
                     pass
 
     def close(self) -> None:
+        for name in list(self._pending):
+            self.wait_pending(name)
         self.aio.close()

@@ -179,3 +179,92 @@ def test_init_inference_checkpoint_errors(tmp_path):
         init_inference(
             model, checkpoint=str(tmp_path), params=model.init(jax.random.PRNGKey(0))
         )
+
+
+# ---------------------------------------------------------------------------
+# r3: fused decode attention kernel + int4 weight-only path
+# ---------------------------------------------------------------------------
+def test_decode_attention_kernel_matches_matvec():
+    """Pallas cached-KV decode == masked fp32 matvec, incl. GQA + short cache
+    in a long buffer (the predication case)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention_kernel
+
+    B, Smax, H, KV, hd = 2, 512, 4, 2, 64
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(B, 1, H, hd), jnp.float32)
+    kc = jnp.asarray(r.randn(B, Smax, KV, hd), jnp.float32)
+    vc = jnp.asarray(r.randn(B, Smax, KV, hd), jnp.float32)
+    for cache_len in (0, 5, 130, 511):
+        out = decode_attention_kernel(q, kc, vc, jnp.asarray(cache_len))
+        # reference: expand GQA, mask beyond cache_len, fp32 softmax
+        kf = jnp.repeat(kc, H // KV, axis=2).astype(jnp.float32)
+        vf = jnp.repeat(vc, H // KV, axis=2).astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kf)
+        logits = logits / np.sqrt(hd)
+        kpos = jnp.arange(Smax)[None, None, None, :]
+        logits = jnp.where(kpos <= cache_len, logits, -1e30)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), vf)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5,
+            err_msg=f"cache_len={cache_len}",
+        )
+
+
+def test_generate_uses_decode_kernel(monkeypatch):
+    """With kernel injection on, the while_loop decode must trace the Pallas
+    decode kernel and produce the same tokens as the XLA matvec."""
+    import deepspeed_tpu
+    import deepspeed_tpu.ops.pallas.decode_attention as da
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.ops.attention import attention_impl
+
+    model = llama("llama-tiny", vocab_size=128, max_seq_len=128,
+                  hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                  intermediate_size=128)
+    eng_ref = deepspeed_tpu.init_inference(model, max_tokens=128)
+    prompt = np.arange(8).reshape(1, 8) % 128
+    ref_tokens = eng_ref.generate(prompt, max_new_tokens=8)
+
+    called = {}
+    orig = da.decode_attention_kernel
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(da, "decode_attention_kernel", spy)
+    # kernel_inject pins "auto" (xla on the CPU suite), which would shadow
+    # the forced scope — build a plain engine and force "flash" around the
+    # trace instead, which is what injection resolves to on a real TPU
+    eng = deepspeed_tpu.init_inference(
+        model, max_tokens=128, params=eng_ref.params,
+    )
+    with attention_impl("flash"):  # force the kernel path on the CPU suite
+        tokens = eng.generate(prompt, max_new_tokens=8)
+    assert called.get("yes"), "decode kernel never traced"
+    np.testing.assert_array_equal(tokens, ref_tokens)
+
+
+def test_int4_weight_only_inference():
+    """dtype="int4" → weight-only 4-bit quant; close to fp output (parity
+    bound loose: 4-bit), and strictly coarser than int8."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    model = llama("llama-tiny", vocab_size=128, max_seq_len=64,
+                  hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                  intermediate_size=128)
+    rng = jax.random.PRNGKey(3)
+    eng_fp = deepspeed_tpu.init_inference(model, dtype=jnp.float32, rng=rng)
+    eng_i4 = deepspeed_tpu.init_inference(model, dtype="int4", rng=rng)
+    assert eng_i4.dtype == jnp.bfloat16  # compute dtype, weights int4-qdq
+
+    ids = np.arange(16).reshape(1, 16) % 128
+    lf = np.asarray(eng_fp(ids), np.float32)
+    l4 = np.asarray(eng_i4(ids), np.float32)
+    # same argmax on most positions; logits within a loose bound
+    agree = (lf.argmax(-1) == l4.argmax(-1)).mean()
+    assert agree > 0.7, agree
+    assert np.max(np.abs(lf - l4)) < 2.0

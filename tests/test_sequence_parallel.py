@@ -105,3 +105,20 @@ def test_sp_engine_parity_with_dp(mode):
             assert abs(ld - ls) < 2e-3, f"step {i}: dense {ld} vs sp/{mode} {ls}"
     finally:
         set_sp_mode("ulysses")
+
+
+def test_ring_attention_alibi():
+    """ALiBi slopes applied from global positions inside the ring (r3: the
+    ring path no longer falls back to ulysses for BLOOM-style models)."""
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    q, k, v = rand_qkv(seed=3)
+    slopes = jnp.asarray(alibi_slopes(4))
+    topo = MeshTopology(dims=ParallelDims(sp=4, dp=2))
+    ref = xla_attention(q, k, v, causal=True, alibi_slopes=slopes)
+    got = jax.jit(
+        lambda a, b, c: ring_attention(
+            a, b, c, causal=True, alibi_slopes=slopes, topo=topo
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
