@@ -332,3 +332,110 @@ class HfEngineAdapter:
         if name == "engine":  # __init__ failed before engine was set
             raise AttributeError(name)
         return getattr(self.engine, name)
+
+
+# ---------------------------------------------------------------------------
+# safetensors file I/O (dependency-free)
+# ---------------------------------------------------------------------------
+# Format: 8-byte LE header length, JSON header {name: {dtype, shape,
+# data_offsets}, "__metadata__": ...}, then one raw little-endian buffer.
+# Implemented directly (zero-egress image may lack the safetensors package);
+# reference parity: the HF loading path of deepspeed's AutoTP/inference.
+_ST_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+
+def read_safetensors(path: str) -> Dict[str, np.ndarray]:
+    """Read one .safetensors file into {name: numpy array} (BF16 → fp32)."""
+    import json
+    import struct
+
+    out = {}
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+        base = 8 + hlen
+        # seek+read per tensor: peak host memory stays one tensor, not the
+        # whole multi-GB shard plus per-tensor copies
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            start, end = meta["data_offsets"]
+            f.seek(base + start)
+            raw = f.read(end - start)
+            shape = tuple(meta["shape"])
+            if meta["dtype"] == "BF16":
+                u16 = np.frombuffer(raw, np.uint16)
+                arr = (u16.astype(np.uint32) << 16).view(np.float32)
+            else:
+                arr = np.frombuffer(raw, _ST_DTYPES[meta["dtype"]])
+            out[name] = arr.reshape(shape)
+    return out
+
+
+def write_safetensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
+    """Write {name: numpy array} in safetensors layout (fp32/int kinds)."""
+    import json
+    import struct
+
+    rev = {v: k for k, v in _ST_DTYPES.items()}
+    header: Dict[str, Any] = {}
+    offset = 0
+    blobs = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        code = rev.get(arr.dtype.type)
+        if code is None:
+            arr = arr.astype(np.float32)
+            code = "F32"
+        blob = arr.tobytes()
+        header[name] = {
+            "dtype": code,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        offset += len(blob)
+        blobs.append(blob)
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for blob in blobs:
+            f.write(blob)
+
+
+def load_hf_checkpoint(path: str, cfg: TransformerConfig,
+                       family: Optional[str] = None) -> Dict[str, Any]:
+    """Load an HF checkpoint directory (or single .safetensors file) into
+    this package's param pytree — no torch/transformers needed.
+
+    Handles single-file and sharded (model.safetensors.index.json) layouts.
+    """
+    import json
+    import os
+
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            files = sorted(
+                {os.path.join(path, fn) for fn in weight_map.values()}
+            )
+        else:
+            files = sorted(
+                os.path.join(path, f)
+                for f in os.listdir(path)
+                if f.endswith(".safetensors")
+            )
+        if not files:
+            raise FileNotFoundError(f"no .safetensors files under {path!r}")
+    sd: Dict[str, np.ndarray] = {}
+    for f in files:
+        sd.update(read_safetensors(f))
+    return import_hf_state_dict(sd, cfg, family)

@@ -51,15 +51,18 @@ class csv_monitor(Monitor):
 
 
 class TensorBoardMonitor(Monitor):
+    """tfevents scalars via the dependency-free native writer (tfevents.py)
+    — a torch-less TPU image still gets real TensorBoard files."""
+
     def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName"):
         self.summary_writer = None
         try:
-            from torch.utils.tensorboard import SummaryWriter
+            from .tfevents import TfEventsWriter
 
-            self.summary_writer = SummaryWriter(
+            self.summary_writer = TfEventsWriter(
                 log_dir=os.path.join(output_path or "tensorboard", job_name)
             )
-        except Exception as e:  # tensorboard not installed → disabled
+        except Exception as e:  # unwritable dir etc. → disabled, not fatal
             log_dist(f"tensorboard monitor disabled: {e}")
 
     def write_events(self, event_list: List[Event]) -> None:
@@ -68,6 +71,11 @@ class TensorBoardMonitor(Monitor):
         for tag, value, step in event_list:
             self.summary_writer.add_scalar(tag, float(value), step)
         self.summary_writer.flush()
+
+    def close(self):
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+            self.summary_writer = None
 
 
 class WandbMonitor(Monitor):

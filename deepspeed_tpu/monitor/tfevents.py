@@ -1,0 +1,115 @@
+"""Dependency-free TensorBoard event-file writer.
+
+Parity: deepspeed/monitor/tb_monitor.py. The reference leans on torch's
+SummaryWriter; a TPU image has no torch, so scalar summaries are encoded
+here directly: protobuf wire format for Event{wall_time, step,
+Summary{Value{tag, simple_value}}} inside TFRecord framing (length +
+masked-CRC32C). TensorBoard reads the resulting events.out.tfevents.*
+files natively.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven — TFRecord framing checksum
+# ---------------------------------------------------------------------------
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf encoding (only what Event/Summary scalars need)
+# ---------------------------------------------------------------------------
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _f_int64(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _f_bytes(field: int, v: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(v)) + v
+
+
+def _scalar_event(tag: str, value: float, step: int, wall_time: float) -> bytes:
+    val = _f_bytes(1, tag.encode()) + _f_float(2, float(value))
+    summary = _f_bytes(1, val)  # Summary.value (repeated)
+    return (
+        _f_double(1, wall_time)  # Event.wall_time
+        + _f_int64(2, int(step))  # Event.step
+        + _f_bytes(5, summary)  # Event.summary
+    )
+
+
+def _version_event(wall_time: float) -> bytes:
+    return _f_double(1, wall_time) + _f_bytes(3, b"brain.Event:2")
+
+
+class TfEventsWriter:
+    """Append scalar events to an events.out.tfevents.* file."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (
+            f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+            f".{os.getpid()}"
+        )
+        self._f = open(os.path.join(log_dir, fname), "ab")
+        self._record(_version_event(time.time()))
+
+    def _record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._record(_scalar_event(tag, value, step, time.time()))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()

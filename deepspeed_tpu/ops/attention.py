@@ -74,6 +74,11 @@ def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
     KV = k.shape[2]
     if KV != H:
         assert H % KV == 0, f"GQA heads {H} not divisible by kv heads {KV}"
+        # materialized repeat, deliberately: a grouped 5-D einsum avoids the
+        # copy but its [B,S,KV,G,hd] reshape adds involuntary-remat
+        # reshardings under Ulysses meshes (measured: 7 warnings vs 5). The
+        # flash kernel is the perf path; this reference impl optimizes for
+        # sharding fidelity.
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))

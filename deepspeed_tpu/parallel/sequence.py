@@ -67,16 +67,58 @@ def ulysses_attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
     """
     from ..ops.attention import attention as attn_op
 
-    # heads over (tp, sp): each device sees H/(tp*sp) heads, full sequence
-    q = constrain(q, ("dp", "fsdp"), None, ("tp", "sp"), None)
-    k = constrain(k, ("dp", "fsdp"), None, ("tp", "sp"), None)
-    v = constrain(v, ("dp", "fsdp"), None, ("tp", "sp"), None)
+    # stage 1: pin the incoming seq-sharded 4D layout, so the backward's
+    # dq/dk/dv reshapes happen inside one sharding instead of resharding
+    # *through* a reshape (GSPMD falls back to full remat there)
+    q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
+    k = constrain(k, ("dp", "fsdp"), "sp", _kv_tp_axis(k.shape[2]), None)
+    v = constrain(v, ("dp", "fsdp"), "sp", _kv_tp_axis(v.shape[2]), None)
+    # stage 2: heads over (sp, tp): each device sees H/(sp*tp) heads, full
+    # sequence. sp-major matches the mesh linearization, so the seq→head
+    # move lowers to one contiguous all-to-all, not a permuted resharding.
+    q = constrain(q, ("dp", "fsdp"), None, ("sp", "tp"), None)
+    kv_ax = _kv_head_axes(k.shape[2])
+    k = constrain(k, ("dp", "fsdp"), None, kv_ax, None)
+    v = constrain(v, ("dp", "fsdp"), None, kv_ax, None)
     out = attn_op(
         q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
         alibi_slopes=alibi_slopes,
     )
     # back to sequence sharding for the rest of the block
     return constrain(out, ("dp", "fsdp"), "sp", "tp", None)
+
+
+def _kv_tp_axis(kv_heads: int):
+    """tp on the head dim when it divides, else replicated (GQA kv < tp)."""
+    topo = current_topology()
+    tp = topo.tp_size if topo is not None else 1
+    return "tp" if tp > 1 and kv_heads % tp == 0 else None
+
+
+def _kv_head_axes(kv_heads: int):
+    """Largest ("sp","tp") combination that divides the KV head count.
+
+    GQA under Ulysses (reference: DeepSpeed-Ulysses requires
+    num_kv_heads % sp == 0, else it replicates KV): when kv_heads < sp*tp
+    the KV tensors can't be fully head-sharded — constraining them onto an
+    oversized axis set forces GSPMD into involuntary full rematerialization
+    (padded 2-over-4 shardings). Shard what divides; the remainder
+    replicates via an sp all-gather, which is the Ulysses-GQA semantics."""
+    topo = current_topology()
+    if topo is None:
+        return None
+    live = [a for a in ("sp", "tp") if topo.sizes[a] > 1]
+    if not live:
+        return None
+    prod = 1
+    for a in live:
+        prod *= topo.sizes[a]
+    if kv_heads % prod == 0:
+        return tuple(live) if len(live) > 1 else live[0]
+    for a in ("tp", "sp"):  # prefer tp: matches the model's TP weight layout
+        if topo.sizes[a] > 1 and kv_heads % topo.sizes[a] == 0:
+            return a
+    return None
 
 
 def _ring_attention_local(q, k, v, seg_q, seg_k, slopes, *, causal: bool,

@@ -122,3 +122,33 @@ def test_ring_attention_alibi():
         )
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "kv_heads,dims,expect_ax",
+    [
+        # kv % (sp*tp) != 0 but kv % tp == 0 → tp-only shard, sp replicates
+        (2, ParallelDims(sp=2, tp=2), "tp"),
+        # tp=1: only the sp axis is live and kv % sp == 0 → sp shard
+        (2, ParallelDims(dp=4, sp=2), "sp"),
+        # kv=2 can't shard over sp=4 at all → fully replicated KV
+        (2, ParallelDims(dp=2, sp=4), None),
+        # MQA under sp*tp: nothing divides → replicated KV
+        (1, ParallelDims(sp=2, tp=2), None),
+    ],
+)
+def test_ulysses_gqa_small_kv_matches_dense(kv_heads, dims, expect_ax):
+    """GQA with kv_heads < sp*tp: the KV constraint falls back to whatever
+    axes divide (or replication) and results stay exact vs dense."""
+    from deepspeed_tpu.models.sharding import use_topology
+    from deepspeed_tpu.parallel.sequence import _kv_head_axes
+
+    q, k, v = rand_qkv(KV=kv_heads, seed=7)
+    topo = MeshTopology(dims=dims)
+    ref = xla_attention(q, k, v, causal=True)
+    with use_topology(topo):
+        assert _kv_head_axes(kv_heads) == expect_ax
+        got = jax.jit(
+            lambda a, b, c: ulysses_attention(a, b, c, causal=True)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
