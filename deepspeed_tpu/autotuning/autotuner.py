@@ -8,7 +8,9 @@ prunes the candidate and, in fast mode, everything larger.
 
 Search space: micro-batch sizes (powers of two up to
 max_train_micro_batch_size_per_gpu) × remat policies (none is tried first
-at each batch — cheapest when it fits, per the memory/compute tradeoff).
+at each batch — cheapest when it fits, per the memory/compute tradeoff),
+then a flash-attention tile sweep (block_q × block_k) refines the winner —
+the "tpu_kernels" knob the engine exposes for exactly this loop.
 """
 
 from __future__ import annotations
@@ -21,6 +23,7 @@ import numpy as np
 from ..utils.logging import log_dist
 
 REMAT_POLICIES = ("none", "attn_mlp", "full")
+FLASH_BLOCKS = ((0, 0), (512, 512), (512, 256), (256, 512), (128, 128))
 
 
 def _is_oom(err: Exception) -> bool:
@@ -54,7 +57,8 @@ class Autotuner:
             m *= 2
         return [(mb, pol) for mb in mbs for pol in REMAT_POLICIES]
 
-    def _measure(self, micro_batch: int, remat: str) -> Optional[float]:
+    def _measure(self, micro_batch: int, remat: str,
+                 blocks: Tuple[int, int] = (0, 0)) -> Optional[float]:
         import deepspeed_tpu
 
         cfg = dict(self.base_config)
@@ -64,6 +68,10 @@ class Autotuner:
         cfg["train_micro_batch_size_per_gpu"] = micro_batch
         cfg["train_batch_size"] = micro_batch * dp * accum
         cfg["activation_checkpointing"] = {"policy": remat}
+        if blocks != (0, 0):
+            tk = dict(cfg.get("tpu_kernels") or {})
+            tk["flash_block_q"], tk["flash_block_k"] = blocks
+            cfg["tpu_kernels"] = tk
         cfg.setdefault("steps_per_print", 10**9)
         engine = None
         try:
@@ -91,8 +99,24 @@ class Autotuner:
             if engine is not None:
                 engine.destroy()  # release logger hooks even on failure
 
+    def _flash_tunable(self) -> bool:
+        """Phase 2 only makes sense when the flash tile knobs are live."""
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return False  # interpret-mode tiles all time the same
+        tk = dict(self.base_config.get("tpu_kernels") or {})
+        if tk.get("flash_attention") is False:
+            return False  # xla impl never reads the tile scope
+        sa = dict(self.base_config.get("sparse_attention") or {})
+        if sa.get("mode", "none") != "none":
+            return False  # sparse pins block_q/block_k to its layout block
+        return True
+
     def tune(self) -> Dict[str, Any]:
-        """Returns the best config patch {micro_batch, remat_policy, throughput}."""
+        """Returns the best config patch: {micro_batch, remat_policy,
+        throughput} plus, when the flash tile sweep improved on it,
+        tpu_kernels-style {flash_block_q, flash_block_k} keys."""
         best = None
         oom_at = None
         for mb, pol in self._candidates():
@@ -110,6 +134,27 @@ class Autotuner:
                 best = rec
         if best is None:
             raise RuntimeError("autotuning found no runnable configuration")
+        # phase 2: flash tile sweep on the winning (mb, remat)
+        if self._flash_tunable():
+            for blocks in FLASH_BLOCKS[1:]:
+                tput = self._measure(
+                    best["micro_batch"], best["remat_policy"], blocks
+                )
+                if tput is None:
+                    continue
+                rec = {
+                    "micro_batch": best["micro_batch"],
+                    "remat_policy": best["remat_policy"],
+                    "flash_block_q": blocks[0],
+                    "flash_block_k": blocks[1],
+                    "throughput": tput,
+                }
+                self.results.append(rec)
+                log_dist(
+                    f"autotune: blocks={blocks}: {tput:.0f} tok/s"
+                )
+                if tput > best["throughput"]:
+                    best = rec
         return best
 
 

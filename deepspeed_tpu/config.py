@@ -313,6 +313,27 @@ class SequenceParallelConfig:
 
 
 @dataclass
+class SparseAttentionConfig:
+    """Parity: the "sparse_attention" ds_config section
+    (deepspeed/ops/sparse_attention/sparsity_config.py schemas)."""
+
+    mode: str = "none"  # none | dense | fixed | bigbird | bslongformer
+    block: int = 128  # TPU tile granularity (reference default 16 is GPU)
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    num_random_blocks: int = 1
+    global_block_indices: List[int] = field(default_factory=lambda: [0])
+
+    def validate(self) -> None:
+        modes = ("none", "dense", "fixed", "bigbird", "bslongformer")
+        if self.mode not in modes:
+            raise DeepSpeedConfigError(
+                f"sparse_attention.mode must be one of {modes}, got {self.mode!r}"
+            )
+
+
+@dataclass
 class TpuKernelsConfig:
     """TPU-native section: which Pallas kernels replace the XLA defaults.
 
@@ -409,6 +430,9 @@ class DeepSpeedConfig:
             sp.setdefault("sp_size", d["sequence_parallel_size"])
         self.sequence_parallel = _parse_dc(SequenceParallelConfig, sp)
         self.tpu_kernels = _parse_dc(TpuKernelsConfig, d.get("tpu_kernels"))
+        self.sparse_attention = _parse_dc(
+            SparseAttentionConfig, d.get("sparse_attention")
+        )
         self.flops_profiler = _parse_dc(FlopsProfilerConfig, d.get("flops_profiler"))
         self.comms_logger = _parse_dc(CommsLoggerConfig, d.get("comms_logger"))
         self.monitor = MonitorConfig(
@@ -500,6 +524,22 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 "random_ltd is not supported with pipeline parallelism (the "
                 "token-subset gather would cross pp stage boundaries)"
+            )
+        self.sparse_attention.validate()
+        if self.sparse_attention.mode not in ("none", "dense") and (
+            self.sequence_parallel.sp_size > 1
+        ):
+            raise DeepSpeedConfigError(
+                "sparse_attention is not supported together with sequence "
+                "parallelism (the block layout assumes full-sequence tiles)"
+            )
+        if self.sparse_attention.mode not in ("none", "dense") and (
+            self.data_efficiency.random_ltd.enabled
+        ):
+            raise DeepSpeedConfigError(
+                "sparse_attention is not supported together with random_ltd "
+                "(LTD layers attend over gathered token subsets whose length "
+                "is not block-aligned with the sparse layout)"
             )
         if self.sequence_parallel.mode not in ("ulysses", "ring"):
             raise DeepSpeedConfigError(

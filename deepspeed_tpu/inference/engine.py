@@ -107,15 +107,27 @@ class InferenceEngine:
         self.dtype = dtype
         self.max_tokens = min(max_tokens, self.config.max_seq_len)
         self.kernel_inject = kernel_inject
-        # "kernel injection" parity: this engine's traces prefer the Pallas
-        # flash prefill ("auto" resolves to flash on TPU); scoped via a
-        # context manager so other engines' pinned impls are untouched
-        from ..ops.attention import attention_impl
+        # "kernel injection" parity (reference: replace_with_kernel_inject
+        # swaps torch blocks for fused CUDA blocks, csrc/transformer/
+        # inference). The TPU translation is a fused *composition*, not one
+        # mega-kernel: Pallas flash prefill + Pallas cached-KV decode
+        # attention (models/decoding.py) + Pallas rmsnorm, with XLA fusing
+        # the matmul/elementwise chains between them. Scoped via context
+        # managers so other engines' kernel choices are untouched.
+        on_tpu = topology.mesh.devices.flat[0].platform == "tpu"
 
-        self._impl_ctx = (
-            (lambda: attention_impl("auto")) if kernel_inject
-            else contextlib.nullcontext
-        )
+        def _injected():
+            from contextlib import ExitStack
+
+            from ..ops.attention import attention_impl
+            from ..ops.normalization import pallas_rmsnorm_scope
+
+            stack = ExitStack()
+            stack.enter_context(attention_impl("auto"))  # flash on TPU
+            stack.enter_context(pallas_rmsnorm_scope(on_tpu))
+            return stack
+
+        self._impl_ctx = _injected if kernel_inject else contextlib.nullcontext
 
         tp_specs = (
             model.partition_specs(topology)

@@ -34,10 +34,17 @@ _override_stack: list = []
 
 
 class attention_impl:
-    """Scoped impl override (no global mutation): with attention_impl("flash")."""
+    """Scoped impl override (no global mutation): with attention_impl("flash").
 
-    def __init__(self, name: str):
-        if name != "auto" and name not in _IMPLS:
+    Accepts a registered impl name or a callable with the attention
+    signature (engine-built wrappers, e.g. block-sparse layouts)."""
+
+    def __init__(self, name):
+        if (
+            isinstance(name, str)
+            and name != "auto"
+            and name not in _IMPLS
+        ):
             raise KeyError(f"unknown attention impl {name!r}; have {sorted(_IMPLS)}")
         self.name = name
 
@@ -49,8 +56,10 @@ class attention_impl:
         _override_stack.pop()
 
 
-def _resolve() -> str:
+def _resolve():
     cur = _override_stack[-1] if _override_stack else _CURRENT
+    if callable(cur):
+        return cur
     if cur != "auto":
         return cur
     if jax.default_backend() == "tpu" and "flash" in _IMPLS:
@@ -107,7 +116,9 @@ register_attention_impl("xla", xla_attention)
 
 def attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
               alibi_slopes=None):
-    return _IMPLS[_resolve()](
+    impl = _resolve()
+    fn = impl if callable(impl) else _IMPLS[impl]
+    return fn(
         q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
         alibi_slopes=alibi_slopes,
     )

@@ -155,3 +155,51 @@ def dense_blocksparse_reference(q, k, v, layout, block, *, causal=True):
     tok_mask = np.kron(np.asarray(layout)[:n, :n], np.ones((block, block)))
     bias = jnp.where(jnp.asarray(tok_mask) > 0, 0.0, -1e30)[None, None]
     return xla_attention(q, k, v, causal=causal, bias=bias)
+
+
+def from_ds_config(sa_cfg) -> Optional[SparsityConfig]:
+    """ds_config "sparse_attention" section → SparsityConfig (None = off).
+
+    Parity: deepspeed/ops/sparse_attention get_sparse_attention_config."""
+    mode = getattr(sa_cfg, "mode", "none")
+    if mode in ("none", None):
+        return None
+    if mode == "dense":
+        return DenseSparsityConfig(block=sa_cfg.block)
+    if mode == "fixed":
+        return FixedSparsityConfig(
+            block=sa_cfg.block,
+            num_local_blocks=sa_cfg.num_local_blocks,
+            num_global_blocks=sa_cfg.num_global_blocks,
+        )
+    if mode == "bigbird":
+        return BigBirdSparsityConfig(
+            block=sa_cfg.block,
+            num_sliding_window_blocks=sa_cfg.num_sliding_window_blocks,
+            num_global_blocks=sa_cfg.num_global_blocks,
+            num_random_blocks=sa_cfg.num_random_blocks,
+        )
+    if mode == "bslongformer":
+        return BSLongformerSparsityConfig(
+            block=sa_cfg.block,
+            num_sliding_window_blocks=sa_cfg.num_sliding_window_blocks,
+            global_block_indices=list(sa_cfg.global_block_indices),
+        )
+    raise ValueError(f"unknown sparse_attention mode {mode!r}")
+
+
+def make_attention_impl(config: SparsityConfig):
+    """An attention-signature callable for the engine's scoped impl stack."""
+
+    def impl(q, k, v, *, causal=True, bias=None, segment_ids=None,
+             alibi_slopes=None):
+        if bias is not None:
+            raise ValueError(
+                "sparse_attention cannot compose with a dense attention bias"
+            )
+        return sparse_attention(
+            q, k, v, config, causal=causal, segment_ids=segment_ids,
+            alibi_slopes=alibi_slopes,
+        )
+
+    return impl

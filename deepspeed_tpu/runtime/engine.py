@@ -174,6 +174,26 @@ class TpuEngine:
         # engines with different configs in one process don't fight. --------
         tk = config.tpu_kernels.resolve(on_tpu)
         self.tpu_kernels = tk
+        self._sparse_impl = None
+        if config.sparse_attention.mode != "none":
+            # training-time block-sparse attention (reference:
+            # SparseSelfAttention driven by the "sparse_attention" section)
+            from ..ops.sparse_attention import from_ds_config, make_attention_impl
+
+            if topology.sp_size > 1 and config.sparse_attention.mode != "dense":
+                # config validation only sees the config's sp_size; an
+                # explicitly passed sp>1 topology must fail here, not apply
+                # a chunk-local block layout silently inside the ring path
+                from ..config import DeepSpeedConfigError
+
+                raise DeepSpeedConfigError(
+                    "sparse_attention is not supported on a sequence-"
+                    "parallel topology (the block layout assumes full-"
+                    "sequence tiles)"
+                )
+            sp_cfg = from_ds_config(config.sparse_attention)
+            if sp_cfg is not None:
+                self._sparse_impl = make_attention_impl(sp_cfg)
         self.pld = None
         if config.progressive_layer_drop.enabled:
             from .progressive_layer_drop import ProgressiveLayerDrop
@@ -491,7 +511,11 @@ class TpuEngine:
         tk = self.tpu_kernels
         stack = ExitStack()
         stack.enter_context(
-            attention_impl("flash" if tk.flash_attention else "xla")
+            attention_impl(
+                self._sparse_impl
+                if self._sparse_impl is not None
+                else ("flash" if tk.flash_attention else "xla")
+            )
         )
         stack.enter_context(pallas_rmsnorm_scope(tk.fused_rmsnorm))
         stack.enter_context(

@@ -89,3 +89,64 @@ def test_fixed_layout_is_causal_friendly():
     for cfg in CONFIGS:
         layout = causal_trim(cfg.make_layout(512))
         assert (np.diag(layout) == 1).all(), type(cfg).__name__
+
+
+def test_engine_sparse_attention_config(devices8, monkeypatch):
+    """ds_config "sparse_attention" drives the train step: the flash kernel
+    receives a block mask and training converges."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    import deepspeed_tpu.ops.pallas.flash_attention as fa
+    from deepspeed_tpu.models import llama
+
+    masks_seen = []
+    orig = fa.flash_attention
+
+    def spy(q, k, v, **kw):
+        masks_seen.append(kw.get("block_mask") is not None)
+        return orig(q, k, v, **kw)
+
+    # the real sparse_attention imports flash_attention from the module at
+    # call time, so this spy observes the genuine engine → sparse → kernel
+    # path (no reimplementation in the test)
+    monkeypatch.setattr(fa, "flash_attention", spy)
+
+    comm.destroy_process_group()
+    model = llama("llama-tiny", vocab_size=128, max_seq_len=256,
+                  hidden_size=64, num_layers=2, num_heads=2, num_kv_heads=2,
+                  intermediate_size=128)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "sparse_attention": {"mode": "fixed", "block": 128,
+                                 "num_local_blocks": 1,
+                                 "num_global_blocks": 1},
+        },
+        rng=jax.random.PRNGKey(0),
+    )
+    data = {"input_ids": np.random.RandomState(0).randint(0, 128, size=(8, 256))}
+    losses = [float(engine.train_batch(batch=data)) for _ in range(10)]
+    assert masks_seen and all(masks_seen), "block mask never reached the kernel"
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_sparse_attention_config_validation():
+    import pytest as _pytest
+
+    from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "sparse_attention": {"mode": "wat"}})
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "sparse_attention": {"mode": "fixed"},
+                         "sequence_parallel": {"sp_size": 2}})
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "sparse_attention": {"mode": "fixed"},
+                         "data_efficiency": {"data_routing": {"random_ltd": {
+                             "enabled": True}}}})
