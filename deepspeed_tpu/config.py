@@ -313,6 +313,20 @@ class SequenceParallelConfig:
 
 
 @dataclass
+class CheckpointConfig:
+    """Parity: the "checkpoint" section + the reference's pluggable
+    checkpoint_engine (torch-native / nebula → native shard files / Orbax)."""
+
+    engine: str = "native"  # native (shard .npy files) | orbax
+
+    def validate(self) -> None:
+        if self.engine not in ("native", "orbax"):
+            raise DeepSpeedConfigError(
+                f"checkpoint.engine must be 'native' or 'orbax', got {self.engine!r}"
+            )
+
+
+@dataclass
 class SparseAttentionConfig:
     """Parity: the "sparse_attention" ds_config section
     (deepspeed/ops/sparse_attention/sparsity_config.py schemas)."""
@@ -433,6 +447,7 @@ class DeepSpeedConfig:
         self.sparse_attention = _parse_dc(
             SparseAttentionConfig, d.get("sparse_attention")
         )
+        self.checkpoint = _parse_dc(CheckpointConfig, d.get("checkpoint"))
         self.flops_profiler = _parse_dc(FlopsProfilerConfig, d.get("flops_profiler"))
         self.comms_logger = _parse_dc(CommsLoggerConfig, d.get("comms_logger"))
         self.monitor = MonitorConfig(
@@ -526,6 +541,7 @@ class DeepSpeedConfig:
                 "token-subset gather would cross pp stage boundaries)"
             )
         self.sparse_attention.validate()
+        self.checkpoint.validate()
         if self.sparse_attention.mode not in ("none", "dense") and (
             self.sequence_parallel.sp_size > 1
         ):

@@ -123,20 +123,89 @@ def _device_view(leaf):
     return leaf
 
 
+_ORBAX_SUBDIR = "orbax"
+
+
+def _clean_component_dir(directory: str) -> None:
+    """Remove the previous generation of BOTH formats before a save: mixing
+    old shard files or a stale orbax/ tree with a fresh save would make the
+    loader's format auto-detect pick up outdated state."""
+    import shutil
+
+    if _is_writer() and os.path.isdir(directory):
+        for f in os.listdir(directory):
+            if f.startswith("leaf_") and f.endswith(".npy"):
+                os.remove(os.path.join(directory, f))
+        stale_orbax = os.path.join(directory, _ORBAX_SUBDIR)
+        if os.path.isdir(stale_orbax):
+            shutil.rmtree(stale_orbax)
+    _barrier("save_tree_clean")
+
+
+def _save_tree_orbax(tree, directory: str) -> Dict[str, Any]:
+    """Orbax engine (reference-parity pluggable checkpoint_engine): tensorstore
+    shard files, per-process writes, async-capable. Same universality: restore
+    takes the *target* engine's shardings."""
+    import orbax.checkpoint as ocp
+
+    os.makedirs(directory, exist_ok=True)
+    _clean_component_dir(directory)
+    # pinned_host (offloaded) leaves bounce to device memory first — not all
+    # PJRT transports can read host-memory shards directly
+    tree = jax.tree.map(_device_view, tree)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(directory, _ORBAX_SUBDIR), tree, force=True)
+    ckptr.wait_until_finished()
+    return {
+        "num_leaves": len(jax.tree_util.tree_leaves(tree)),
+        "leaf_names": _leaf_paths(tree),
+        "format": "orbax",
+    }
+
+
+def _load_tree_orbax(template, directory: str, shardings=None,
+                     strict: bool = True):
+    import orbax.checkpoint as ocp
+
+    if shardings is None:
+        target = jax.tree.map(
+            lambda o: jax.ShapeDtypeStruct(o.shape, o.dtype), template
+        )
+    else:
+        target = jax.tree.map(
+            lambda o, s: jax.ShapeDtypeStruct(o.shape, o.dtype, sharding=s),
+            template,
+            shardings,
+        )
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(os.path.join(directory, _ORBAX_SUBDIR), target=target)
+    except Exception:
+        if strict:
+            raise
+        # coarser than the native engine's per-leaf fallback: Orbax restores
+        # whole trees, so a structure/shape mismatch keeps the component's
+        # current values wholesale
+        log_dist(
+            f"strict=False: orbax restore of {directory} failed "
+            f"(structure/shape mismatch); keeping current values for this "
+            f"component"
+        )
+        if shardings is None:
+            return template
+        return jax.device_put(template, shardings)
+
+
 def _save_tree(tree, directory: str) -> Dict[str, Any]:
     """Shard-wise save: each process writes replica-0 addressable shards.
 
     No leaf is ever gathered unsharded (reference parity:
     deepspeed/runtime/checkpoint_engine writes rank-local shard files)."""
     os.makedirs(directory, exist_ok=True)
-    if _is_writer():
-        # clear the previous generation: a re-save under a different mesh
-        # writes different bounds tokens, and mixing generations would
-        # assemble corrupt arrays
-        for f in os.listdir(directory):
-            if f.startswith("leaf_") and f.endswith(".npy"):
-                os.remove(os.path.join(directory, f))
-    _barrier("save_tree_clean")
+    # clear the previous generation (either format): a re-save under a
+    # different mesh writes different bounds tokens, and mixing generations
+    # or formats would assemble corrupt/stale arrays
+    _clean_component_dir(directory)
     leaves = jax.tree_util.tree_leaves(tree)
     names = _leaf_paths(tree)
     for i, leaf in enumerate(leaves):
@@ -304,8 +373,13 @@ def save_checkpoint(
         "opt_state": state.opt_state,
         "loss_scale": state.loss_scale,
     }
+    use_orbax = (
+        getattr(getattr(engine.config, "checkpoint", None), "engine", "native")
+        == "orbax"
+    )
+    saver = _save_tree_orbax if use_orbax else _save_tree
     for name, tree in trees.items():
-        meta["components"][name] = _save_tree(tree, os.path.join(path, name))
+        meta["components"][name] = saver(tree, os.path.join(path, name))
     if _is_writer():
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(meta, f, indent=1)
@@ -345,20 +419,19 @@ def load_checkpoint(
     def stored_names(component):
         return (meta.get("components", {}).get(component) or {}).get("leaf_names")
 
-    params = _load_tree(
-        state.params, os.path.join(path, "params"), engine.param_shardings,
-        strict, stored_names("params"),
-    )
-    opt_state = _load_tree(
-        state.opt_state, os.path.join(path, "opt_state"), engine.opt_shardings,
-        strict, stored_names("opt_state"),
-    )
-    loss_scale = _load_tree(
+    def load_component(template, component, shardings):
+        cdir = os.path.join(path, component)
+        # format auto-detected from disk, so either engine reads either layout
+        if os.path.isdir(os.path.join(cdir, _ORBAX_SUBDIR)):
+            return _load_tree_orbax(template, cdir, shardings, strict)
+        return _load_tree(template, cdir, shardings, strict, stored_names(component))
+
+    params = load_component(state.params, "params", engine.param_shardings)
+    opt_state = load_component(state.opt_state, "opt_state", engine.opt_shardings)
+    loss_scale = load_component(
         state.loss_scale,
-        os.path.join(path, "loss_scale"),
+        "loss_scale",
         jax.tree.map(lambda _: engine._replicated, state.loss_scale),
-        strict,
-        stored_names("loss_scale"),
     )
 
     import jax.numpy as jnp
@@ -397,6 +470,8 @@ def load_params(load_dir: str, template, tag: Optional[str] = None):
     path = _tag_dir(load_dir, tag)
     if not os.path.isdir(os.path.join(path, "params")):
         raise FileNotFoundError(f"checkpoint {path!r} has no params component")
+    if os.path.isdir(os.path.join(path, "params", _ORBAX_SUBDIR)):
+        return _load_tree_orbax(template, os.path.join(path, "params"))
     names = None
     meta_path = os.path.join(path, "metadata.json")
     if os.path.exists(meta_path):

@@ -846,7 +846,12 @@ class TpuEngine:
                 k: (np.asarray(v)[:, :difficulty] if np.asarray(v).ndim >= 2 else v)
                 for k, v in batch.items()
             }
+        breakdown = self.config.wall_clock_breakdown
+        if breakdown:
+            self.timers("batch_prep").start()
         prepared = self._prepare_batch(batch)
+        if breakdown:
+            self.timers("batch_prep").stop()
         ltd_keep = None
         if self.random_ltd is not None:
             # skipped (fp16-overflow) steps must not advance the anneal —
@@ -857,6 +862,8 @@ class TpuEngine:
             seq = prepared["input_ids"].shape[-1]
             if ltd_keep >= seq:
                 ltd_keep = None  # schedule annealed past full length
+        if breakdown:
+            self.timers("step_dispatch").start()
         with use_topology(self.topology):
             if self._nvme_swapper is not None:
                 # dispatch grads async, then overlap the NVMe swap-in with
@@ -874,6 +881,14 @@ class TpuEngine:
                     *self.state.astuple(), prepared, self.next_rng(), ltd_keep
                 )
         self.state = TrainState(p, o, s, st)
+        if breakdown:
+            # dispatch returns immediately; a second timer blocks on the
+            # device so the pair splits host time from device time
+            self.timers("step_dispatch").stop()
+            self.timers("step_device").start()
+            self.timers("step_device").stop(block_on=metrics["loss"])
+            if (self.global_steps + 1) % self.config.steps_per_print == 0:
+                self.timers.log(["batch_prep", "step_dispatch", "step_device"])
         if self._nvme_swapper is not None:
             self._swap_out_opt(blocking=False)  # writes overlap next step
         self.global_steps += 1

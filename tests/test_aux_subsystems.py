@@ -229,3 +229,28 @@ def test_pld_engine_trains():
     for _ in range(3):
         loss = engine.train_batch(batch={"input_ids": r.randint(0, 64, size=(8, 16))})
         assert np.isfinite(float(loss))
+
+
+def test_wall_clock_breakdown_times_steps(devices8, caplog):
+    """wall_clock_breakdown=True populates the engine's timer registry and
+    logs a breakdown line at steps_per_print (r3: flag was parsed, unused)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.models import gpt2
+
+    comm.destroy_process_group()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "wall_clock_breakdown": True,
+            "steps_per_print": 2,
+        },
+    )
+    data = {"input_ids": np.random.RandomState(0).randint(0, 64, size=(8, 16))}
+    for _ in range(3):  # step 2 logs + resets; step 3 leaves counts visible
+        engine.train_batch(batch=data)
+    names = set(engine.timers.timers)
+    assert {"batch_prep", "step_dispatch", "step_device"} <= names
+    assert engine.timers("step_device").count >= 1
