@@ -37,19 +37,20 @@ def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
 
-    B, S = 8, 2048
+    smoke = bool(os.environ.get("BENCH_SMOKE"))  # CPU end-to-end validation
+    B, S = (4, 256) if smoke else (8, 2048)
     # head_dim=128 matches the MXU lane width (hd=64 runs the attention
     # matmuls at half MXU utilization: measured 1.6x slower end-to-end)
     model = llama(
         "llama-tiny",
-        vocab_size=32768,
+        vocab_size=1024 if smoke else 32768,
         max_seq_len=S,
-        hidden_size=1024,
-        num_layers=24,
+        hidden_size=128 if smoke else 1024,
+        num_layers=2 if smoke else 24,
         num_heads=8,
         num_kv_heads=4,
-        head_dim=128,
-        intermediate_size=4096,
+        head_dim=16 if smoke else 128,
+        intermediate_size=512 if smoke else 4096,
     )
     cfg = model.config
     engine, *_ = deepspeed_tpu.initialize(
@@ -66,7 +67,9 @@ def main():
             "activation_checkpointing": {"policy": "none"},
         },
     )
-    data = {"input_ids": np.random.RandomState(0).randint(0, 32768, size=(B, S))}
+    data = {
+        "input_ids": np.random.RandomState(0).randint(0, cfg.vocab_size, size=(B, S))
+    }
 
     engine.train_batch(batch=data)  # compile
     times = []
@@ -122,11 +125,19 @@ def main():
             pass
     baseline = max(priors) if priors else None
     vs = tok_per_sec / baseline if baseline else 1.0
+    if smoke:
+        # CPU validation run: TPU-peak MFU and real-TPU priors are
+        # meaningless here — don't feed a ratchet false regressions
+        vs, mfu = 1.0, 0.0
 
     print(
         json.dumps(
             {
-                "metric": "llama-410M train tokens/sec/chip (bf16, seq2048, MFU attached)",
+                "metric": (
+                    "SMOKE-MODE bench validation (not a perf record)"
+                    if smoke
+                    else "llama-410M train tokens/sec/chip (bf16, seq2048, MFU attached)"
+                ),
                 "value": round(tok_per_sec, 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(vs, 4),

@@ -633,12 +633,38 @@ def flash_attention(
         from jax.sharding import PartitionSpec as P
 
         batch_axes = tuple(a for a in ("dp", "fsdp") if topo.sizes[a] > 1)
-        b_ax = batch_axes if batch_axes else None
         head_axes = tuple(
             a for a in (("tp",) if sp == 1 else ("tp", "sp"))
             if topo.sizes[a] > 1
         )
+        # inside an enclosing manual shard_map (pipeline schedule, stacked-
+        # grads 1-bit path) some axes are already Manual: the nested
+        # shard_map must use the context's abstract mesh and may only map
+        # the still-Auto axes — arrays arrive already local on Manual ones
+        am = jax.sharding.get_abstract_mesh()
+        in_manual = (
+            am is not None
+            and not am.empty
+            and any(t == jax.sharding.AxisType.Manual for t in am.axis_types)
+        )
+        if in_manual:
+            auto = {
+                name
+                for name, t in zip(am.axis_names, am.axis_types)
+                if t == jax.sharding.AxisType.Auto
+            }
+            batch_axes = tuple(a for a in batch_axes if a in auto)
+            head_axes = tuple(a for a in head_axes if a in auto)
+        b_ax = batch_axes if batch_axes else None
         h_ax = head_axes if head_axes else None
+        mapped = set(batch_axes) | set(head_axes)
+
+        if not mapped:
+            # everything relevant is already Manual/local: run the kernel
+            # directly on the local shards
+            out = kernel(qt, kt, vt, seg, slopes, mask)
+            return jnp.swapaxes(out, 1, 2)
+
         spec_q = P(b_ax, h_ax, None, None)
         # shard_map can't take None operands: pass dummies, re-None inside
         s_in = seg if seg is not None else jnp.zeros((B, S), jnp.int32)
@@ -653,9 +679,12 @@ def flash_attention(
                 m_ if mask is not None else None,
             )
 
+        kw = {}
+        if in_manual:
+            kw["axis_names"] = mapped
         out = shard_map(
             body,
-            mesh=topo.mesh,
+            mesh=am if in_manual else topo.mesh,
             in_specs=(
                 spec_q, spec_q, spec_q,
                 P(b_ax, None),  # segment ids: full sequence per shard
@@ -664,6 +693,7 @@ def flash_attention(
             ),
             out_specs=spec_q,
             check_vma=False,
+            **kw,
         )(qt, kt, vt, s_in, sl_in, m_in)
     else:
         out = kernel(qt, kt, vt, seg, slopes, mask)

@@ -241,3 +241,73 @@ def test_flash_under_sp_mesh(devices8):
     assert called.get("yes"), "sp>1 fell back to XLA"
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     comm.destroy_process_group()
+
+
+def test_flash_inside_manual_context_all_axes_manual(devices8):
+    """pp-only topology: inside the pipeline's manual region no Auto axes
+    remain — flash must run the kernel directly (axis_names=set() crashes
+    shard_map)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.comm import MeshTopology, ParallelDims
+    from deepspeed_tpu.models import llama
+
+    comm.destroy_process_group()
+    topo = MeshTopology(ParallelDims(pp=2), devices=jax.devices()[:2])
+    comm.set_topology(topo)
+    model = llama("llama-tiny", vocab_size=256, max_seq_len=128,
+                  hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=4,
+                  intermediate_size=128)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, topology=topo,
+        config={
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "pipeline": {"stages": 2},
+            "tpu_kernels": {"flash_attention": True},
+        },
+        rng=jax.random.PRNGKey(0),
+    )
+    loss = engine.train_batch(
+        batch={"input_ids": np.random.RandomState(0).randint(0, 256, size=(4, 128))}
+    )
+    assert np.isfinite(float(loss))
+    comm.destroy_process_group()
+
+
+def test_flash_under_onebit_stacked_grads(devices8):
+    """1-bit wire path manualizes the dp axis; flash's nested shard_map must
+    only map the still-Auto axes (r3 review repro)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.comm import MeshTopology, ParallelDims
+    from deepspeed_tpu.models import llama
+
+    comm.destroy_process_group()
+    topo = MeshTopology(ParallelDims(dp=4, tp=2), devices=jax.devices())
+    comm.set_topology(topo)
+    model = llama("llama-tiny", vocab_size=256, max_seq_len=128,
+                  hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=4,
+                  intermediate_size=128)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, topology=topo,
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 2}},
+            "zero_optimization": {"stage": 1},
+            "tpu_kernels": {"flash_attention": True},
+        },
+        rng=jax.random.PRNGKey(0),
+    )
+    assert engine._stacked_grads_axes  # the wire path is actually active
+    losses = [
+        float(engine.train_batch(
+            batch={"input_ids": np.random.RandomState(i).randint(0, 256, size=(8, 128))}
+        ))
+        for i in range(3)
+    ]
+    assert np.isfinite(losses).all()
+    comm.destroy_process_group()

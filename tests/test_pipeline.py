@@ -214,3 +214,42 @@ def test_zero2_plus_pipeline_rejected():
                 "pipeline": {"stages": 2},
             }
         )
+
+
+def test_pipeline_with_flash_kernel(devices8):
+    """The flash kernel nests inside the pipeline's manual shard_map (r3:
+    previously crashed with a mesh mismatch on real-TPU default config)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.comm import MeshTopology, ParallelDims
+    from deepspeed_tpu.models import llama
+
+    def run(flash):
+        comm.destroy_process_group()
+        topo = MeshTopology(ParallelDims(dp=2, pp=2, tp=2), devices=jax.devices())
+        comm.set_topology(topo)
+        model = llama(
+            "llama-tiny", vocab_size=512, max_seq_len=128, hidden_size=64,
+            num_layers=4, num_heads=4, num_kv_heads=4, intermediate_size=176,
+        )
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, topology=topo,
+            config={
+                "train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "pipeline": {"stages": 2},
+                "tpu_kernels": {"flash_attention": flash},
+            },
+            rng=jax.random.PRNGKey(0),
+        )
+        data = {
+            "input_ids": np.random.RandomState(0).randint(0, 512, size=(8, 128))
+        }
+        return float(engine.train_batch(batch=data))
+
+    l_flash = run(True)
+    l_xla = run(False)
+    assert abs(l_flash - l_xla) < 2e-3, (l_flash, l_xla)
