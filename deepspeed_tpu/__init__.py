@@ -11,6 +11,7 @@ schedules instead of NCCL process groups.
 from .version import __version__  # noqa: F401
 from .config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
 from .comm import init_distributed  # noqa: F401
+from . import zero  # noqa: F401  (deepspeed.zero parity surface)
 
 
 def initialize(*args, **kwargs):

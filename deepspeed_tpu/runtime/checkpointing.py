@@ -450,13 +450,11 @@ def load_checkpoint(
     return path, meta.get("client_state", {})
 
 
-def load_params(load_dir: str, template, tag: Optional[str] = None):
-    """Load just the model-params component of an engine checkpoint.
-
-    ``template`` is a pytree of arrays or ShapeDtypeStructs with the target
-    structure (e.g. ``jax.eval_shape(model.init, key)``). Used by
-    ``init_inference(checkpoint=...)`` to serve trained weights without
-    constructing a training engine."""
+def resolve_tag(load_dir: str, tag: Optional[str] = None,
+                component: Optional[str] = "params") -> str:
+    """Resolve a checkpoint tag (``latest`` file when None) to its directory,
+    checking the requested component exists. Shared by load_params and the
+    zero_to_fp32 export (deepspeed_tpu/zero.py)."""
     if tag is None:
         latest = os.path.join(load_dir, "latest")
         if not os.path.exists(latest):
@@ -468,8 +466,21 @@ def load_params(load_dir: str, template, tag: Optional[str] = None):
         with open(latest) as f:
             tag = f.read().strip()
     path = _tag_dir(load_dir, tag)
-    if not os.path.isdir(os.path.join(path, "params")):
-        raise FileNotFoundError(f"checkpoint {path!r} has no params component")
+    if component and not os.path.isdir(os.path.join(path, component)):
+        raise FileNotFoundError(
+            f"checkpoint {path!r} has no {component} component"
+        )
+    return path
+
+
+def load_params(load_dir: str, template, tag: Optional[str] = None):
+    """Load just the model-params component of an engine checkpoint.
+
+    ``template`` is a pytree of arrays or ShapeDtypeStructs with the target
+    structure (e.g. ``jax.eval_shape(model.init, key)``). Used by
+    ``init_inference(checkpoint=...)`` to serve trained weights without
+    constructing a training engine."""
+    path = resolve_tag(load_dir, tag)
     if os.path.isdir(os.path.join(path, "params", _ORBAX_SUBDIR)):
         return _load_tree_orbax(template, os.path.join(path, "params"))
     names = None

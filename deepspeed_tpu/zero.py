@@ -1,0 +1,142 @@
+"""ZeRO user-facing API shims.
+
+Parity: deepspeed.zero (Init, GatheredParameters, register_external_parameter)
+and deepspeed/utils/zero_to_fp32.py. In this framework parameters are one
+logical sharded array per tensor, so most of the reference's machinery is a
+no-op by construction:
+
+- ``zero.Init``: the engine already materializes params sharded
+  (``jax.jit(model.init, out_shardings=...)`` — see runtime/engine.py); the
+  context exists so reference training scripts run unmodified.
+- ``GatheredParameters``: gather-on-use is XLA-inserted; entering the
+  context yields fully-gathered host copies when materialization is really
+  wanted (export/debug), otherwise arrays are used as-is.
+- ``get_fp32_state_dict_from_zero_checkpoint``: reads a checkpoint written
+  by save_checkpoint (native shard files or Orbax) and returns the fp32
+  params as one host state dict — no engine required, any mesh's shards.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def Init(*args, **kwargs):
+    """Parity: deepspeed.zero.Init — module construction under ZeRO-3.
+
+    Sharded construction happens inside ``initialize()`` here (params are
+    born sharded via out_shardings), so the context is a documented no-op."""
+    yield
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank: Optional[int] = None, **kwargs):
+    """Parity: deepspeed.zero.GatheredParameters.
+
+    Yields host (numpy) copies of the given pytree — the explicit
+    "materialize the full parameter" escape hatch. Writes back are the
+    caller's responsibility (functional params have no in-place mutation):
+    the reference's modifier_rank write-back contract cannot hold here, so
+    passing it warns loudly."""
+    import jax
+
+    from .runtime.checkpointing import _to_host
+    from .utils.logging import log_dist
+
+    if modifier_rank is not None:
+        log_dist(
+            "warning: zero.GatheredParameters(modifier_rank=...) yields "
+            "DETACHED host copies — in-context mutations are NOT written "
+            "back to the sharded parameters (functional arrays); rebuild "
+            "the param pytree and pass it to initialize(model_parameters=...)"
+        )
+    # _to_host handles multi-host non-addressable shards (all-gather) and
+    # pinned_host offloaded leaves (device bounce) — plain device_get fails
+    # on both
+    yield jax.tree.map(_to_host, params)
+
+
+def register_external_parameter(module, param) -> None:
+    """Parity: deepspeed.zero.register_external_parameter — a no-op: XLA's
+    sharding propagation already tracks every array used in the step."""
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+    checkpoint_dir: str, tag: Optional[str] = None
+) -> Dict[str, Any]:
+    """Parity: deepspeed.utils.zero_to_fp32 — assemble the full fp32 model
+    state from a (possibly sharded) engine checkpoint, without an engine.
+
+    Returns {pytree-path: np.ndarray}. Works for both the native shard-file
+    layout and the Orbax layout."""
+    from .runtime.checkpointing import (
+        _ORBAX_SUBDIR,
+        _assemble_leaf,
+        _index_shard_files,
+        resolve_tag,
+    )
+
+    path = resolve_tag(checkpoint_dir, tag)
+    pdir = os.path.join(path, "params")
+
+    if os.path.isdir(os.path.join(pdir, _ORBAX_SUBDIR)):
+        import jax
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        odir = os.path.join(pdir, _ORBAX_SUBDIR)
+        # restore against abstract shapes from the checkpoint's own metadata
+        # (target-less restore is flagged unsafe by orbax)
+        try:
+            md = ckptr.metadata(odir)
+            target = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), md
+            )
+            tree = ckptr.restore(odir, target=target)
+        except Exception:
+            tree = ckptr.restore(odir)
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return {
+            jax.tree_util.keystr(p): np.asarray(v, np.float32) for p, v in flat
+        }
+
+    files = _index_shard_files(pdir)
+    names = None
+    meta_path = os.path.join(path, "metadata.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            names = (
+                json.load(f).get("components", {}).get("params") or {}
+            ).get("leaf_names")
+    if names is None:  # pre-name-metadata checkpoints: positional keys
+        names = [f"leaf_{i:05d}" for i in sorted(files)]
+    out: Dict[str, Any] = {}
+    for i, name in enumerate(names):
+        entries = files.get(i)
+        if not entries:
+            raise FileNotFoundError(
+                f"checkpoint missing shard files for leaf {name!r} (index {i})"
+            )
+        out[name] = np.asarray(_assemble_leaf(entries), np.float32)
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+    checkpoint_dir: str, output_file: str, tag: Optional[str] = None
+) -> None:
+    """Parity: zero_to_fp32.py's CLI entry — write the assembled state dict
+    to one .npz archive."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+
+
+if __name__ == "__main__":  # python -m deepspeed_tpu.zero <ckpt_dir> <out.npz>
+    import sys
+
+    convert_zero_checkpoint_to_fp32_state_dict(sys.argv[1], sys.argv[2])
