@@ -1,0 +1,32 @@
+"""Serve a trained checkpoint with TP sharding + kernel injection.
+
+python examples/inference_llama.py [checkpoint_dir]
+"""
+import sys
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import llama
+
+
+def main():
+    model = llama(
+        "llama-tiny", vocab_size=32000, max_seq_len=512, hidden_size=512,
+        num_layers=8, num_heads=8, num_kv_heads=4, intermediate_size=1408,
+    )
+    engine = deepspeed_tpu.init_inference(
+        model,
+        tp_size=1,  # set >1 on a multi-chip mesh
+        dtype="int8",  # weight-only quantized serving ("int4" also works)
+        replace_with_kernel_inject=True,
+        checkpoint=sys.argv[1] if len(sys.argv) > 1 else None,
+        max_tokens=512,
+    )
+    prompt = np.random.RandomState(0).randint(0, 32000, size=(1, 16))
+    tokens = engine.generate(prompt, max_new_tokens=32, temperature=0.7, top_k=50)
+    print("generated:", tokens[0, 16:].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Train a Llama-style model with ZeRO-3 from a ds_config.json.
+
+Single chip:   python examples/train_llama_zero3.py
+Multi-chip:    parallel dims come from the config/topology; see README.
+"""
+import os
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+
+def synthetic_dataset(n=4096, seq=512, vocab=32000, seed=0):
+    r = np.random.RandomState(seed)
+    return {"input_ids": r.randint(0, vocab, size=(n, seq))}
+
+
+def main():
+    cfg_path = os.path.join(os.path.dirname(__file__), "ds_config_zero3.json")
+    model = llama(
+        "llama-tiny", vocab_size=32000, max_seq_len=512, hidden_size=512,
+        num_layers=8, num_heads=8, num_kv_heads=4, intermediate_size=1408,
+    )
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg_path, training_data=synthetic_dataset()
+    )
+    data = RepeatingLoader(loader)
+    for step in range(200):
+        loss = engine.train_batch(data_iter=data)
+        if step % 50 == 0:
+            engine.save_checkpoint("ckpts")
+    print("final loss", float(loss))
+
+
+if __name__ == "__main__":
+    main()
