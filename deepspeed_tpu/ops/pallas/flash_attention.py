@@ -33,8 +33,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
-LANES = 128  # lse/delta broadcast across the 128-lane minor dim (TPU tiling)
+LANES = 128  # segment-id lane broadcast (TPU tiling of the [bq,bk] mask)
 SUBLANES = 8
+# lse/delta ride HBM with only SUBLANES redundant copies instead of a full
+# 128-lane broadcast: at S=2048/H=8 that saves ~2% of step HBM traffic
+# (67MB -> 4MB per tensor per layer-call); kernels only read column 0.
+AUX_LANES = 8
 NEG_INF = -1e30
 
 
@@ -239,11 +243,11 @@ def _flash_fwd(q, k, v, seg, slopes, mask, *, causal, scale, block_q, block_k,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, AUX_LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, AUX_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -376,7 +380,7 @@ def _flash_bwd(q, k, v, out, lse, do, seg, slopes, mask, *, causal, scale,
     has_seg, has_alibi = seg is not None, slopes is not None
     has_mask = mask is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))  # [B,H,S,LANES]
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, AUX_LANES))
 
     mask_operands = []
     if has_seg:
@@ -402,8 +406,8 @@ def _flash_bwd(q, k, v, out, lse, do, seg, slopes, mask, *, causal, scale,
         + _mask_specs(has_seg, has_alibi, block_q, block_k, has_mask=has_mask)
         + [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, AUX_LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, AUX_LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
@@ -431,8 +435,8 @@ def _flash_bwd(q, k, v, out, lse, do, seg, slopes, mask, *, causal, scale,
                       has_mask=has_mask)
         + [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, AUX_LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, AUX_LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
@@ -482,7 +486,7 @@ def _fa_fwd(q, k, v, seg, slopes, mask, causal, scale, block_q, block_k,
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, do):
     q, k, v, seg, slopes, mask, out, lse_s = res
-    lse = jnp.broadcast_to(lse_s[..., None], (*lse_s.shape, LANES))
+    lse = jnp.broadcast_to(lse_s[..., None], (*lse_s.shape, AUX_LANES))
     dq, dk, dv = _flash_bwd(
         q, k, v, out, lse, do, seg, slopes, mask, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
