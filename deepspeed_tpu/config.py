@@ -331,7 +331,7 @@ class SparseAttentionConfig:
     """Parity: the "sparse_attention" ds_config section
     (deepspeed/ops/sparse_attention/sparsity_config.py schemas)."""
 
-    mode: str = "none"  # none | dense | fixed | bigbird | bslongformer
+    mode: str = "none"  # none | dense | fixed | bigbird | bslongformer | variable
     block: int = 128  # TPU tile granularity (reference default 16 is GPU)
     num_local_blocks: int = 4
     num_global_blocks: int = 1
@@ -340,7 +340,7 @@ class SparseAttentionConfig:
     global_block_indices: List[int] = field(default_factory=lambda: [0])
 
     def validate(self) -> None:
-        modes = ("none", "dense", "fixed", "bigbird", "bslongformer")
+        modes = ("none", "dense", "fixed", "bigbird", "bslongformer", "variable")
         if self.mode not in modes:
             raise DeepSpeedConfigError(
                 f"sparse_attention.mode must be one of {modes}, got {self.mode!r}"

@@ -117,6 +117,44 @@ class BSLongformerSparsityConfig(SparsityConfig):
         return layout
 
 
+@dataclass
+class VariableSparsityConfig(SparsityConfig):
+    """Parity: VariableSparsityConfig — local windows of varying width
+    (``local_window_blocks``, last entry repeats), chosen global block
+    indices, plus random blocks."""
+
+    num_random_blocks: int = 0
+    local_window_blocks: List[int] = field(default_factory=lambda: [4])
+    global_block_indices: List[int] = field(default_factory=lambda: [0])
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        layout = np.zeros((n, n), np.int32)
+        # tile variable-width local windows over the block axis
+        start = 0
+        widths = list(self.local_window_blocks) or [1]
+        wi = 0
+        while start < n:
+            w = widths[min(wi, len(widths) - 1)]
+            end = min(start + w, n)
+            layout[start:end, start:end] = 1
+            start = end
+            wi += 1
+        for g in self.global_block_indices:
+            if g < n:
+                layout[:, g] = 1
+                layout[g, :] = 1
+        rng = np.random.RandomState(self.seed)
+        for qi in range(n):
+            if self.num_random_blocks:
+                for ki in rng.choice(
+                    n, size=min(self.num_random_blocks, n), replace=False
+                ):
+                    layout[qi, ki] = 1
+        return layout
+
+
 def causal_trim(layout: np.ndarray) -> np.ndarray:
     """Zero strictly-upper block diagonals (the kernel also causal-masks
     inside diagonal blocks; this just documents the block-level layout)."""
@@ -183,6 +221,13 @@ def from_ds_config(sa_cfg) -> Optional[SparsityConfig]:
         return BSLongformerSparsityConfig(
             block=sa_cfg.block,
             num_sliding_window_blocks=sa_cfg.num_sliding_window_blocks,
+            global_block_indices=list(sa_cfg.global_block_indices),
+        )
+    if mode == "variable":
+        return VariableSparsityConfig(
+            block=sa_cfg.block,
+            num_random_blocks=sa_cfg.num_random_blocks,
+            local_window_blocks=[sa_cfg.num_local_blocks],
             global_block_indices=list(sa_cfg.global_block_indices),
         )
     raise ValueError(f"unknown sparse_attention mode {mode!r}")

@@ -11,6 +11,7 @@ from deepspeed_tpu.ops.sparse_attention import (
     BSLongformerSparsityConfig,
     DenseSparsityConfig,
     FixedSparsityConfig,
+    VariableSparsityConfig,
     causal_trim,
     dense_blocksparse_reference,
     sparse_attention,
@@ -33,6 +34,8 @@ CONFIGS = [
                           num_global_blocks=1, num_random_blocks=1),
     BSLongformerSparsityConfig(block=128, num_sliding_window_blocks=3,
                                global_block_indices=[0]),
+    VariableSparsityConfig(block=128, local_window_blocks=[1, 2],
+                           global_block_indices=[0], num_random_blocks=1),
 ]
 
 
