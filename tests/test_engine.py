@@ -165,3 +165,18 @@ def test_hpz_fsdp_subaxis(devices8):
     spec = str(wq.sharding.spec)
     assert "fsdp" in spec and "'dp'" not in spec  # params shard only on sub-axis
     assert losses[-1] < losses[0]
+
+
+def test_initialize_from_args_namespace(devices8, tmp_path):
+    """Reference CLI pattern: deepspeed.initialize(args) where
+    args.deepspeed_config points at a ds_config.json file."""
+    import argparse
+    import json
+
+    cfg_path = tmp_path / "ds_config.json"
+    cfg_path.write_text(json.dumps(BASE_CFG))
+    args = argparse.Namespace(deepspeed_config=str(cfg_path), local_rank=0)
+    comm.destroy_process_group()
+    engine, *_ = deepspeed_tpu.initialize(args=args, model=_model())
+    loss = engine.train_batch(batch=_data())
+    assert np.isfinite(float(loss))
