@@ -60,7 +60,9 @@ def main():
     # least-recompute policy that fits HBM: "none" keeps device flops ==
     # model flops (honest MFU); the ladder degrades on OOM instead of dying
     policy = os.environ.get("BENCH_REMAT", "")
-    ladder = [policy] if policy else ["none", "dots_saveable", "attn_mlp", "full"]
+    ladder = [policy] if policy else [
+        "none", "dots_flash", "dots_saveable", "attn_mlp", "full",
+    ]
     engine = None
     for pol in ladder:
         try:

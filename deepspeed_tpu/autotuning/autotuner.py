@@ -22,7 +22,7 @@ import numpy as np
 
 from ..utils.logging import log_dist
 
-REMAT_POLICIES = ("none", "attn_mlp", "full")
+REMAT_POLICIES = ("none", "dots_flash", "attn_mlp", "full")
 FLASH_BLOCKS = ((0, 0), (512, 512), (512, 256), (256, 512), (128, 128))
 
 

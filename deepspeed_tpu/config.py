@@ -163,7 +163,7 @@ class ActivationCheckpointingConfig:
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
-    policy: str = "none"  # none | full | dots_saveable | attn_only | offload_host
+    policy: str = "none"  # none | full | dots_saveable | dots_flash | attn_only | offload_host
 
 
 @dataclass

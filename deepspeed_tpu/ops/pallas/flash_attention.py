@@ -476,12 +476,22 @@ def _flash_attention_bhsd(q, k, v, seg, slopes, mask, causal, scale, block_q,
 
 def _fa_fwd(q, k, v, seg, slopes, mask, causal, scale, block_q, block_k,
             interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _flash_fwd(
         q, k, v, seg, slopes, mask, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    # store residual lse as [B,H,S] (drop the 128 redundant lane copies)
-    return out, (q, k, v, seg, slopes, mask, out, lse[..., 0])
+    # Name the kernel outputs so remat policies can save them: under plain
+    # dots_saveable a jax.checkpoint'd block re-runs this whole forward
+    # kernel in backward just to regenerate (out, lse) — the "dots_flash"
+    # policy (runtime/activation_checkpointing.py) saves these two tensors
+    # (~S*D + S floats per head) and XLA dead-code-eliminates the recompute.
+    out = checkpoint_name(out, "flash_out")
+    # tag the residual lse AFTER dropping the redundant lane copies so the
+    # policy saves [B,H,S], not the kernel's [B,H,S,AUX_LANES] layout
+    lse_s = checkpoint_name(lse[..., 0], "flash_lse")
+    return out, (q, k, v, seg, slopes, mask, out, lse_s)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, do):

@@ -28,6 +28,15 @@ def _register_policies():
             "attn_only": cp.save_only_these_names("attn_out"),
             "attn_mlp": cp.save_only_these_names("attn_out", "mlp_out"),
             "nothing": cp.nothing_saveable,
+            # dots_saveable + the flash-attention kernel outputs (tagged in
+            # ops/pallas/flash_attention._fa_fwd): saves matmul outputs AND
+            # (out, lse), so backward recomputes only elementwise chains —
+            # the flash forward kernel never re-runs. Memory over plain
+            # dots_saveable: +[B,H,S,D]+[B,H,S] per layer (~3% at S=2048).
+            "dots_flash": cp.save_from_both_policies(
+                cp.dots_saveable,
+                cp.save_only_these_names("flash_out", "flash_lse"),
+            ),
         }
     )
     if hasattr(cp, "save_and_offload_only_these_names"):

@@ -311,3 +311,63 @@ def test_flash_under_onebit_stacked_grads(devices8):
     ]
     assert np.isfinite(losses).all()
     comm.destroy_process_group()
+
+
+def _count_pallas_calls(closed_jaxpr):
+    """Recursively count pallas_call eqns (remat-recompute detector)."""
+    n = 0
+    seen = set()
+
+    def walk(j):
+        nonlocal n
+        if id(j) in seen:
+            return
+        seen.add(id(j))
+        for eqn in j.eqns:
+            if "pallas" in str(eqn.primitive):
+                n += 1
+            for v in eqn.params.values():
+                for x in v if isinstance(v, (tuple, list)) else [v]:
+                    if hasattr(x, "jaxpr"):
+                        walk(x.jaxpr)
+                    elif hasattr(x, "eqns"):
+                        walk(x)
+
+    walk(closed_jaxpr.jaxpr)
+    return n
+
+
+def test_dots_flash_policy_skips_fwd_recompute():
+    """The dots_flash remat policy saves the kernel outputs (checkpoint_name
+    tags in _fa_fwd), so backward must NOT re-run the forward kernel:
+    3 pallas calls (fwd, dq, dkv) vs dots_saveable's 4 (+fwd recompute)."""
+    from deepspeed_tpu.runtime.activation_checkpointing import policy_by_name
+
+    q, k, v = _qkv(jax.random.PRNGKey(3), B=1, S=256, H=2, D=64)
+
+    def counts(policy_name):
+        f = jax.checkpoint(
+            lambda q, k, v: flash_attention(q, k, v, interpret=True).sum(),
+            policy=policy_by_name(policy_name),
+            prevent_cse=False,
+        )
+        return _count_pallas_calls(jax.make_jaxpr(jax.grad(f))(q, k, v))
+
+    assert counts("dots_saveable") == 4
+    assert counts("dots_flash") == 3
+
+
+def test_dots_flash_policy_grads_match():
+    from deepspeed_tpu.runtime.activation_checkpointing import policy_by_name
+
+    q, k, v = _qkv(jax.random.PRNGKey(4), B=1, S=256, H=2, D=64)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, interpret=True) ** 2).sum()
+
+    ref = jax.grad(loss)(q, k, v)
+    got = jax.grad(
+        jax.checkpoint(loss, policy=policy_by_name("dots_flash"),
+                       prevent_cse=False)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
