@@ -358,7 +358,7 @@ class TpuKernelsConfig:
     """
 
     flash_attention: Any = AUTO  # auto | True | False
-    fused_rmsnorm: Any = False  # XLA fuses the norm chain well; opt-in
+    fused_rmsnorm: Any = False  # covers rmsnorm AND layernorm; opt-in
     fused_adam: Any = False  # optax update already fuses into the step
     flash_block_q: int = 0  # 0 => kernel default
     flash_block_k: int = 0

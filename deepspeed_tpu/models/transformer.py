@@ -193,11 +193,12 @@ def _norm(cfg: TransformerConfig, p: Params, x: jax.Array) -> jax.Array:
         from ..ops.normalization import rmsnorm
 
         return rmsnorm(x32, p["scale"].astype(jnp.float32), cfg.norm_eps).astype(x.dtype)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    out = (x32 - mean) * lax.rsqrt(var + cfg.norm_eps)
-    out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
-    return out.astype(x.dtype)
+    from ..ops.normalization import layernorm
+
+    return layernorm(
+        x32, p["scale"].astype(jnp.float32), p["bias"].astype(jnp.float32),
+        cfg.norm_eps,
+    ).astype(x.dtype)
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float):

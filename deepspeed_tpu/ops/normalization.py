@@ -42,3 +42,18 @@ def rmsnorm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
         return pallas_rmsnorm(x, scale, eps)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     return x * lax.rsqrt(var + eps) * scale
+
+
+def layernorm(
+    x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    """LayerNorm over the last dim; same scoped Pallas dispatch as rmsnorm
+    (one tpu_kernels knob covers both norm flavors — a model uses only one)."""
+    use_pallas = _scope_stack[-1] if _scope_stack else _USE_PALLAS
+    if use_pallas:
+        from .pallas.layernorm import layernorm as pallas_layernorm
+
+        return pallas_layernorm(x, scale, bias, eps)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * scale + bias

@@ -161,3 +161,81 @@ def test_pallas_rmsnorm_fwd_bwd():
     g2 = jax.grad(ref_fn, argnums=(0, 1))(x, scale)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_layernorm_fwd_bwd():
+    from deepspeed_tpu.ops.pallas.layernorm import layernorm as pallas_layernorm
+
+    r = np.random.RandomState(5)
+    x = jnp.asarray(r.randn(4, 16, 128).astype(np.float32))
+    scale = jnp.asarray(r.randn(128).astype(np.float32))
+    bias = jnp.asarray(r.randn(128).astype(np.float32))
+
+    def ref_fn(x, s, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return jnp.sum(((x - mean) * jax.lax.rsqrt(var + 1e-5) * s + b) ** 2)
+
+    def pallas_fn(x, s, b):
+        return jnp.sum(pallas_layernorm(x, s, b, 1e-5) ** 2)
+
+    np.testing.assert_allclose(
+        float(pallas_fn(x, scale, bias)), float(ref_fn(x, scale, bias)), rtol=1e-5
+    )
+    g1 = jax.grad(pallas_fn, argnums=(0, 1, 2))(x, scale, bias)
+    g2 = jax.grad(ref_fn, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_layernorm_uneven_rows():
+    """Rows not a multiple of the block: padding must not corrupt dscale/dbias."""
+    from deepspeed_tpu.ops.pallas.layernorm import layernorm as pallas_layernorm
+
+    r = np.random.RandomState(6)
+    x = jnp.asarray(r.randn(300, 128).astype(np.float32))
+    scale = jnp.asarray(r.randn(128).astype(np.float32))
+    bias = jnp.asarray(r.randn(128).astype(np.float32))
+
+    def ref(x, s, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * s + b
+
+    np.testing.assert_allclose(
+        np.asarray(pallas_layernorm(x, scale, bias, 1e-5)),
+        np.asarray(ref(x, scale, bias)), rtol=1e-5, atol=1e-5,
+    )
+    g1 = jax.grad(
+        lambda s, b: jnp.sum(pallas_layernorm(x, s, b, 1e-5) ** 2), argnums=(0, 1)
+    )(scale, bias)
+    g2 = jax.grad(
+        lambda s, b: jnp.sum(ref(x, s, b) ** 2), argnums=(0, 1)
+    )(scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_bloom_trains_with_fused_layernorm():
+    """BLOOM (layernorm family) trains with tpu_kernels.fused_rmsnorm on —
+    the knob routes layernorm through the Pallas kernel via the same scope."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import bloom
+
+    model = bloom(
+        "bloom-tiny", vocab_size=256, max_seq_len=64, hidden_size=64,
+        num_layers=2, num_heads=4, intermediate_size=128,
+    )
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 0},
+            "tpu_kernels": {"fused_rmsnorm": True},
+        },
+    )
+    batch = {"input_ids": np.random.RandomState(0).randint(0, 256, size=(8, 64))}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
