@@ -362,6 +362,11 @@ class TpuKernelsConfig:
     fused_adam: Any = False  # optax update already fuses into the step
     flash_block_q: int = 0  # 0 => kernel default
     flash_block_k: int = 0
+    # vocab-chunked cross-entropy (ops/cross_entropy.py): the [B,S,V] logit
+    # tensor never materializes. auto => on for TPU (tp=1 meshes only; the
+    # vocab-parallel dense path handles tp>1)
+    fused_ce: Any = AUTO
+    ce_chunk: int = 4096
 
     def resolve(self, on_tpu: bool) -> "TpuKernelsConfig":
         def res(v):
@@ -373,6 +378,8 @@ class TpuKernelsConfig:
             fused_adam=res(self.fused_adam),
             flash_block_q=int(self.flash_block_q),
             flash_block_k=int(self.flash_block_k),
+            fused_ce=res(self.fused_ce),
+            ce_chunk=int(self.ce_chunk),
         )
 
 

@@ -110,6 +110,53 @@ def build_ssh_command(host: str, env: Dict[str, str], argv: List[str]) -> List[s
     return ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
 
 
+def wait_and_propagate(procs: List["subprocess.Popen"], poll_s: float = 1.0) -> int:
+    """Babysit the per-host processes (reference: the pdsh runner's job
+    control): if any host's process exits nonzero, terminate the rest —
+    a multi-host SPMD job can't make progress with a dead rank, and the
+    surviving ranks would hang in their next collective. SIGINT/SIGTERM
+    to the launcher fan out to every host."""
+    import signal
+    import time
+
+    signaled = []
+
+    def _forward(signum, frame):
+        signaled.append(signum)
+
+    def _shutdown(rc: int) -> int:
+        """terminate → 10s grace → kill, so a rank that traps/ignores
+        SIGTERM can't wedge the launcher."""
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10.0
+        for p in procs:
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        return rc
+
+    old = (signal.signal(signal.SIGINT, _forward),
+           signal.signal(signal.SIGTERM, _forward))
+    try:
+        while True:
+            if signaled:
+                return _shutdown(128 + signaled[0])
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                return max(abs(c) for c in codes) if any(codes) else 0
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed:
+                return _shutdown(abs(failed[0]))
+            time.sleep(poll_s)
+    finally:
+        signal.signal(signal.SIGINT, old[0])
+        signal.signal(signal.SIGTERM, old[1])
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="deepspeed_tpu", description=__doc__,
@@ -153,10 +200,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         procs.append(subprocess.Popen(cmd))
     if args.dry_run:
         return 0
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+    return wait_and_propagate(procs)
 
 
 if __name__ == "__main__":

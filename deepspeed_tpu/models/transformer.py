@@ -463,12 +463,19 @@ def embed_tokens(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
     return constrain(x, *lead, ("dp", "fsdp"), "sp", None)
 
 
+def lm_head_weight(cfg: TransformerConfig, params: Params) -> jax.Array:
+    """[d, V] output-projection weight (tied or standalone) — the single
+    source of the head-layout convention for both the dense-logits and
+    fused-CE loss paths."""
+    return params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
+
+
 def lm_head_logits(cfg: TransformerConfig, params: Params, y: jax.Array) -> jax.Array:
     """Final projection → fp32 logits [..., S, V] (vocab tp-sharded).
 
     Operands stay in the compute dtype (bf16 → full MXU rate) with fp32
     accumulation; an fp32×fp32 matmul here would run ~8x slower on TPU."""
-    head = params["embed"]["tok"].T if cfg.tie_embeddings else params["lm_head"]
+    head = lm_head_weight(cfg, params)
     logits = jnp.einsum(
         "...sd,dv->...sv", y, head.astype(y.dtype),
         preferred_element_type=jnp.float32,
@@ -501,8 +508,11 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
           positions: Optional[jax.Array] = None, segment_ids=None,
           remat_policy: Optional[str] = None, pld_keep=None,
           ltd_keep: Optional[int] = None,
-          ltd_layers: Optional[Tuple[int, int]] = None) -> Tuple[jax.Array, jax.Array]:
-    """Forward pass → (logits fp32 [B,S,V], moe_aux_loss)."""
+          ltd_layers: Optional[Tuple[int, int]] = None,
+          return_hidden: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass → (logits fp32 [B,S,V], moe_aux_loss); with
+    ``return_hidden`` the final normed hidden [B,S,d] instead of logits
+    (the fused-CE path projects chunk-wise itself)."""
     B, S = input_ids.shape
     pos_default = positions is None
     if positions is None:
@@ -516,6 +526,8 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
         remat_policy, pld_keep, ltd_keep, ltd_layers, pos_default,
     )
     x = _norm(cfg, cast(params["final_norm"]), x)
+    if return_hidden:
+        return x, aux
     return lm_head_logits(cfg, params, x), aux
 
 
@@ -525,6 +537,29 @@ def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
             ltd_keep: Optional[int] = None,
             ltd_layers: Optional[Tuple[int, int]] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy (fp32), labels < 0 are ignored (HF -100 style)."""
+    from ..ops.cross_entropy import (
+        chunked_masked_ce,
+        fused_ce_applicable,
+        fused_ce_config,
+    )
+    from .sharding import current_topology
+
+    fused_on, ce_chunk = fused_ce_config()
+    if fused_on and fused_ce_applicable(cfg.vocab_size, ce_chunk,
+                                        current_topology()):
+        # memory path: final hidden → chunked CE, [B,S,V] never materializes
+        x, aux = apply(
+            cfg, params, batch["input_ids"], dtype=dtype, train=train, rng=rng,
+            segment_ids=batch.get("segment_ids"),
+            positions=batch.get("positions"), remat_policy=remat_policy,
+            pld_keep=pld_keep, ltd_keep=ltd_keep, ltd_layers=ltd_layers,
+            return_hidden=True,
+        )
+        ce, denom = chunked_masked_ce(
+            x, lm_head_weight(cfg, params), batch["labels"], ce_chunk
+        )
+        total = ce + cfg.moe_aux_loss_coef * aux if cfg.is_moe else ce
+        return total, {"lm_loss": ce, "moe_aux_loss": aux, "tokens": denom}
     logits, aux = apply(
         cfg, params, batch["input_ids"], dtype=dtype, train=train, rng=rng,
         segment_ids=batch.get("segment_ids"), positions=batch.get("positions"),

@@ -151,6 +151,7 @@ class TpuEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        self.training = True
         self._micro_buffer = []
         self._metrics = {}
         self.monitor = None
@@ -521,6 +522,9 @@ class TpuEngine:
         stack.enter_context(
             block_sizes_scope(tk.flash_block_q, tk.flash_block_k)
         )
+        from ..ops.cross_entropy import fused_ce_scope
+
+        stack.enter_context(fused_ce_scope(tk.fused_ce, tk.ce_chunk))
         return stack
 
     def _loss_for(self, params, mb, key, scale, pld_keep=None, ltd_keep=None):
@@ -970,14 +974,16 @@ class TpuEngine:
 
     # -- reference imperative protocol ---------------------------------------
     def forward(self, batch):
-        """Parity: engine(batch) → train-mode loss (also buffers the batch
-        for backward/step).
+        """Parity: engine(batch) → loss in the engine's current train/eval
+        mode (engine.train()/engine.eval(); train mode also buffers the
+        batch for backward/step).
 
         Note: the SPMD fast path is train_batch() — this protocol re-runs the
         forward inside the fused train step at the accumulation boundary, so
         it costs one extra forward per microbatch versus train_batch().
         """
-        self._pending_batch = batch
+        if self.training:
+            self._pending_batch = batch
         if "labels" not in batch:
             from ..models.transformer import make_lm_batch
 
@@ -985,7 +991,9 @@ class TpuEngine:
         sharding = self._batch_sharding(accum_leading=False)
         prepared = {k: jax.device_put(np.asarray(v), sharding) for k, v in batch.items()}
         with use_topology(self.topology):
-            loss, _ = self._jit_eval(self.state.params, prepared, self.next_rng(), True)
+            loss, _ = self._jit_eval(
+                self.state.params, prepared, self.next_rng(), self.training
+            )
         return loss
 
     def backward(self, loss=None, batch=None):
@@ -1012,6 +1020,27 @@ class TpuEngine:
         return self.train_batch(batch=merged)
 
     __call__ = forward
+
+    # ------------------------------------------------- nn.Module-ish parity
+    # (DeepSpeedEngine subclasses torch.nn.Module; user loops call these)
+    @property
+    def module(self):
+        """Parity: engine.module — the wrapped model object."""
+        return self.model
+
+    def train(self, mode: bool = True):
+        """Parity: engine.train() — records the mode flag. Train/eval
+        behavior here is selected per call (train_batch vs eval_batch);
+        the flag only answers engine.training queries."""
+        self.training = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Parity no-op: grads are functional values produced inside the
+        jitted step, never accumulated into persistent buffers."""
 
     # ----------------------------------------------------------- properties
     @property

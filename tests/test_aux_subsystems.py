@@ -254,3 +254,35 @@ def test_wall_clock_breakdown_times_steps(devices8, caplog):
     names = set(engine.timers.timers)
     assert {"batch_prep", "step_dispatch", "step_device"} <= names
     assert engine.timers("step_device").count >= 1
+
+
+def test_launcher_failure_propagation():
+    """One dead rank must take the job down (reference pdsh-runner job
+    control): the launcher terminates surviving hosts instead of hanging."""
+    import subprocess
+    import sys
+    import time
+
+    from deepspeed_tpu.launcher.runner import wait_and_propagate
+
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"]),
+        subprocess.Popen([sys.executable, "-c", "raise SystemExit(3)"]),
+    ]
+    rc = wait_and_propagate(procs, poll_s=0.1)
+    assert rc == 3
+    assert all(p.poll() is not None for p in procs)
+    assert time.monotonic() - t0 < 30  # did not wait for the sleeper
+
+
+def test_launcher_all_success():
+    import subprocess
+    import sys
+
+    from deepspeed_tpu.launcher.runner import wait_and_propagate
+
+    procs = [
+        subprocess.Popen([sys.executable, "-c", "pass"]) for _ in range(2)
+    ]
+    assert wait_and_propagate(procs, poll_s=0.05) == 0

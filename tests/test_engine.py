@@ -180,3 +180,22 @@ def test_initialize_from_args_namespace(devices8, tmp_path):
     engine, *_ = deepspeed_tpu.initialize(args=args, model=_model())
     loss = engine.train_batch(batch=_data())
     assert np.isfinite(float(loss))
+
+
+def test_engine_module_train_eval_parity_shims():
+    """DeepSpeedEngine nn.Module-ish surface: module/train/eval/zero_grad."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    model = gpt2("gpt2-tiny", vocab_size=128, max_seq_len=32, hidden_size=32,
+                 num_layers=1, num_heads=2, intermediate_size=64)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+    )
+    assert engine.module is model
+    assert engine.training
+    assert engine.eval() is engine and not engine.training
+    assert engine.train() is engine and engine.training
+    engine.zero_grad()  # documented no-op

@@ -1,0 +1,91 @@
+"""Inference decode benchmark: tokens/sec on the real chip.
+
+The training bench (bench.py) is the driver-facing metric; this companion
+measures the latency-critical decode loop (reference headline:
+DeepSpeed-Inference kernel injection serving). Prints one JSON line:
+  {"decode_tok_s": ..., "prefill_s": ..., "kernel_inject": ...}
+
+Usage:  python tools/bench_decode.py [--no-inject] [--dtype bf16|int8|int4]
+CPU smoke: BENCH_SMOKE=1 (tiny model, interpret kernels).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-inject", action="store_true")
+    ap.add_argument("--dtype", default="bf16",
+                    choices=["bf16", "int8", "int4"])
+    ap.add_argument("--new-tokens", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    model = llama(
+        "llama-tiny",
+        vocab_size=1024 if smoke else 32768,
+        max_seq_len=256 if smoke else 2048,
+        hidden_size=128 if smoke else 1024,
+        num_layers=2 if smoke else 24,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=16 if smoke else 128,
+        intermediate_size=512 if smoke else 4096,
+    )
+    engine = deepspeed_tpu.init_inference(
+        model,
+        tp_size=1,
+        dtype={"bf16": jnp.bfloat16, "int8": "int8", "int4": "int4"}[args.dtype],
+        replace_with_kernel_inject=not args.no_inject,
+        max_tokens=256 if smoke else 2048,
+    )
+    B, prompt_len = 1, 16 if smoke else 128
+    new = 16 if smoke else args.new_tokens
+    prompt = np.random.RandomState(0).randint(
+        0, model.config.vocab_size, size=(B, prompt_len)
+    )
+    engine.generate(prompt, max_new_tokens=4)  # compile prefill + decode
+
+    t0 = time.perf_counter()
+    engine.generate(prompt, max_new_tokens=4)
+    prefill_s = time.perf_counter() - t0  # ~prefill + 4 steps
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = engine.generate(prompt, max_new_tokens=new)
+        np.asarray(out)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))  # full generate time
+    # decode-only rate: subtract the measured prefill(+4 steps) run
+    decode_s = max(dt - prefill_s, 1e-9)
+    print(
+        json.dumps(
+            {
+                "decode_tok_s": round((new - 4) / decode_s, 1),
+                "generate_s": round(dt, 4),
+                "prefill_s": round(prefill_s, 4),
+                "new_tokens": new,
+                "dtype": args.dtype,
+                "kernel_inject": not args.no_inject,
+                "smoke": smoke,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
