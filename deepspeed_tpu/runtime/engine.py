@@ -558,43 +558,65 @@ class TpuEngine:
         )
 
     def _compute_grads(self, params, batch, rng, scale, step=None, ltd_keep=None):
-        """(grads fp32 mean-over-microbatches, mean loss). ``batch`` has a
-        leading grad-accum dim. Overridden by PipelineEngine (the pipeline
-        schedule consumes all microbatches in one pipelined pass)."""
+        """(grads fp32 mean-over-microbatches, mean loss, model metrics).
+        ``batch`` has a leading grad-accum dim. Overridden by PipelineEngine
+        (the pipeline schedule consumes all microbatches in one pass).
+
+        Model metrics (lm_loss, moe_aux_loss, tokens) ride through so the
+        engine can log them (reference: MoE aux loss in the step log);
+        scalars are microbatch means, token counts sum."""
         accum = self.config.gradient_accumulation_steps
         grad_fn = jax.value_and_grad(self._loss_for, has_aux=True)
         pld_keep = self._pld_keep(step)
         if accum == 1:
             # fast path: no scan, no zeros-init accumulator HBM traffic
             key = jax.random.fold_in(rng, 0)
-            (_, (loss, _m)), grads = grad_fn(
+            (_, (loss, m)), grads = grad_fn(
                 params, jax.tree.map(lambda x: x[0], batch), key, scale,
                 pld_keep, ltd_keep,
             )
             inv = 1.0 / scale
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-            return grads, loss
+            return grads, loss, m
 
         zero_grads = jax.tree.map(
             lambda x: jnp.zeros(x.shape, jnp.float32), params
         )
 
         def accum_body(carry, xs):
-            g_acc, loss_acc = carry
+            g_acc, loss_acc, m_acc = carry
             mb, key = xs
-            (_, (loss, _m)), grads = grad_fn(
+            (_, (loss, m)), grads = grad_fn(
                 params, mb, key, scale, pld_keep, ltd_keep
             )
             g_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), g_acc, grads)
-            return (g_acc, loss_acc + loss), None
+            m_acc = jax.tree.map(lambda a, v: a + v, m_acc, m)
+            return (g_acc, loss_acc + loss, m_acc), None
 
         keys = jax.random.split(rng, accum)
-        (grads, loss_sum), _ = jax.lax.scan(
-            accum_body, (zero_grads, jnp.zeros((), jnp.float32)), (batch, keys)
+        # zero scan-carry derived from the model's actual metric tree (shape
+        # eval only — no compute), so custom models with their own metric
+        # structure accumulate fine
+        m_shape = jax.eval_shape(
+            lambda p, mb, k: self._loss_for(p, mb, k, scale, pld_keep, ltd_keep),
+            params, jax.tree.map(lambda x: x[0], batch), keys[0],
+        )[1][1]
+        zero_m = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), m_shape)
+        (grads, loss_sum, m_sum), _ = jax.lax.scan(
+            accum_body,
+            (zero_grads, jnp.zeros((), jnp.float32), zero_m),
+            (batch, keys),
         )
         inv = 1.0 / (accum * scale)
         grads = jax.tree.map(lambda g: g * inv, grads)
-        return grads, loss_sum / accum
+        if isinstance(m_sum, dict):
+            # counts ("tokens") stay sums; everything else reports the mean
+            mmetrics = {
+                k: (v if k == "tokens" else v / accum) for k, v in m_sum.items()
+            }
+        else:
+            mmetrics = jax.tree.map(lambda v: v / accum, m_sum)
+        return grads, loss_sum / accum, mmetrics
 
     def _compute_grads_stacked(self, params, batch, rng, scale, step,
                                ltd_keep=None):
@@ -681,8 +703,9 @@ class TpuEngine:
             grads, loss = self._compute_grads_stacked(
                 params, batch, rng, scale, step, ltd_keep
             )
+            mmetrics = {}  # 1-bit wire path: loss only (local stacked grads)
         else:
-            grads, loss = self._compute_grads(
+            grads, loss, mmetrics = self._compute_grads(
                 params, batch, rng, scale, step, ltd_keep
             )
 
@@ -693,9 +716,10 @@ class TpuEngine:
                 grads,
                 self.grad_shardings,
             )
-        return grads, loss
+        return grads, loss, mmetrics
 
-    def _apply_update(self, params, opt_state, loss_scale, step, grads, loss):
+    def _apply_update(self, params, opt_state, loss_scale, step, grads, loss,
+                      mmetrics=None):
         """The optimizer half of the step (overflow skip, clip, update)."""
         cfg = self.config
         # offloaded state: explicit copies host→device for compute; the step's
@@ -750,15 +774,18 @@ class TpuEngine:
             "overflow": overflow,
             "loss_scale": new_scale.scale,
             "lr": self.lr_schedule(step),
+            **(mmetrics or {}),  # lm_loss / moe_aux_loss / tokens
         }
         return new_params, new_opt, new_scale, new_step, metrics
 
     def _train_step(self, params, opt_state, loss_scale, step, batch, rng,
                     ltd_keep=None):
-        grads, loss = self._grads_and_loss(
+        grads, loss, mmetrics = self._grads_and_loss(
             params, loss_scale, step, batch, rng, ltd_keep
         )
-        return self._apply_update(params, opt_state, loss_scale, step, grads, loss)
+        return self._apply_update(
+            params, opt_state, loss_scale, step, grads, loss, mmetrics
+        )
 
     def _eval_step(self, params, batch, rng, train: bool = False):
         # eval sees the same weights the train step optimizes
@@ -872,13 +899,13 @@ class TpuEngine:
             if self._nvme_swapper is not None:
                 # dispatch grads async, then overlap the NVMe swap-in with
                 # the device's fwd+bwd time; the update program follows
-                grads, loss = self._jit_grads(
+                grads, loss, mmetrics = self._jit_grads(
                     self.state.params, self.state.loss_scale, self.state.step,
                     prepared, self.next_rng(), ltd_keep,
                 )
                 self._swap_in_opt()
                 p, o, s, st, metrics = self._jit_update(
-                    *self.state.astuple(), grads, loss
+                    *self.state.astuple(), grads, loss, mmetrics
                 )
             else:
                 p, o, s, st, metrics = self._jit_train(
@@ -906,18 +933,29 @@ class TpuEngine:
                 f"step {self.global_steps}: fp16 overflow, skipping update "
                 f"(new scale {float(metrics['loss_scale'])})"
             )
+        show_moe = "moe_aux_loss" in metrics and getattr(
+            getattr(self.model, "config", None), "is_moe", False
+        )
         if self.monitor and self.global_steps % self.config.steps_per_print == 0:
-            self.monitor.write_events(
-                [
-                    ("Train/loss", float(metrics["loss"]), self.global_steps),
-                    ("Train/lr", float(metrics["lr"]), self.global_steps),
-                    ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
-                ]
-            )
+            events = [
+                ("Train/loss", float(metrics["loss"]), self.global_steps),
+                ("Train/lr", float(metrics["lr"]), self.global_steps),
+                ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
+            ]
+            if show_moe:
+                events.append((
+                    "Train/moe_aux_loss", float(metrics["moe_aux_loss"]),
+                    self.global_steps,
+                ))
+            self.monitor.write_events(events)
         elif self.global_steps % self.config.steps_per_print == 0:
+            aux = (
+                f" moe_aux={float(metrics['moe_aux_loss']):.4f}" if show_moe else ""
+            )
             log_dist(
                 f"step {self.global_steps}: loss={float(metrics['loss']):.4f} "
                 f"lr={float(metrics['lr']):.3e} gnorm={float(metrics['grad_norm']):.3f}"
+                f"{aux}"
             )
         return metrics["loss"]
 

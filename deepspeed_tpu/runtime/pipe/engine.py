@@ -61,4 +61,4 @@ class PipelineEngine(TpuEngine):
         (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
         inv = 1.0 / scale
         grads = jax.tree.map(lambda g: g.astype(jax.numpy.float32) * inv, grads)
-        return grads, loss
+        return grads, loss, {}  # pipeline loss reduces metrics in-schedule

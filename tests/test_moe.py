@@ -131,3 +131,32 @@ def test_residual_moe_convergence_smoke():
         params, opt, loss = step(params, opt)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_moe_aux_loss_in_step_metrics(devices8):
+    """The train step surfaces model metrics (reference: MoE aux loss is
+    visible in DeepSpeed's step logging/monitor)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import mixtral
+
+    model = mixtral(
+        "mixtral-tiny", vocab_size=256, max_seq_len=32, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, intermediate_size=128,
+        num_experts=4, moe_top_k=2,
+    )
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        },
+    )
+    engine.train_batch(
+        batch={"input_ids": np.random.RandomState(0).randint(0, 256, size=(16, 32))}
+    )
+    m = engine._metrics
+    assert {"lm_loss", "moe_aux_loss", "tokens"} <= set(m)
+    assert float(m["moe_aux_loss"]) > 0
+    assert float(m["tokens"]) > 0
