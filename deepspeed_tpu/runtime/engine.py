@@ -478,6 +478,18 @@ class TpuEngine:
             f"micro_batch={config.train_micro_batch_size_per_gpu}, "
             f"accum={config.gradient_accumulation_steps}"
         )
+        if config.memory_breakdown:
+            # reference: memory_breakdown prints see_memory_usage around the
+            # step; here at init + every steps_per_print (train_batch)
+            from ..utils.memory import print_zero_memory_estimates, see_memory_usage
+
+            print_zero_memory_estimates(
+                model, topology, stages=(config.zero_config.stage,),
+                compute_dtype_bytes=jnp.dtype(self.compute_dtype).itemsize,
+                offload_optimizer=config.zero_config.offload_optimizer.enabled,
+                offload_params=config.zero_config.offload_param.enabled,
+            )
+            see_memory_usage("after engine init")
 
     # ------------------------------------------------------------------ step
     def _device_params(self, params):
@@ -933,6 +945,13 @@ class TpuEngine:
                 f"step {self.global_steps}: fp16 overflow, skipping update "
                 f"(new scale {float(metrics['loss_scale'])})"
             )
+        if (
+            self.config.memory_breakdown
+            and self.global_steps % self.config.steps_per_print == 0
+        ):
+            from ..utils.memory import see_memory_usage
+
+            see_memory_usage(f"step {self.global_steps}")
         show_moe = "moe_aux_loss" in metrics and getattr(
             getattr(self.model, "config", None), "is_moe", False
         )

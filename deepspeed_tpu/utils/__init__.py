@@ -1,3 +1,10 @@
 from .logging import log_dist, logger, warning_once  # noqa: F401
+from .memory import (  # noqa: F401
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs,
+    estimate_zero_model_states_mem_needs,
+    print_zero_memory_estimates,
+    see_memory_usage,
+)
 from .timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401
 from .tree import global_norm, tree_cast, tree_size, tree_zeros_like  # noqa: F401

@@ -1,0 +1,161 @@
+"""Memory introspection + ZeRO memory estimators.
+
+Parity: deepspeed.runtime.utils.see_memory_usage and the
+estimate_zero{2,3}_model_states_mem_needs tools (deepspeed/runtime/zero/
+stage_1_and_2.py / stage3.py) users run before picking a stage. TPU-native:
+device stats come from PJRT ``memory_stats()`` (HBM), host stats from
+/proc/self/status; the estimators model the same fp16/fp32 state math the
+reference prints, parameterized by mesh axis sizes instead of world size.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .logging import log_dist
+
+_GB = 1 << 30
+
+
+def _device_stats() -> Dict[str, float]:
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {
+        "bytes_in_use": float(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": float(stats.get("peak_bytes_in_use", 0)),
+        "bytes_limit": float(stats.get("bytes_limit", 0)),
+    }
+
+
+def _host_rss_bytes() -> float:
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def see_memory_usage(message: str = "", force: bool = True) -> Dict[str, float]:
+    """Log device HBM + host RSS usage; returns the numbers (bytes).
+
+    Parity: deepspeed.runtime.utils.see_memory_usage(message, force)."""
+    if not force:
+        return {}
+    dev = _device_stats()
+    rss = _host_rss_bytes()
+    log_dist(
+        f"{message} | HBM in use {dev['bytes_in_use'] / _GB:.2f}GB "
+        f"(peak {dev['peak_bytes_in_use'] / _GB:.2f}GB, "
+        f"limit {dev['bytes_limit'] / _GB:.2f}GB) | host RSS {rss / _GB:.2f}GB"
+    )
+    return {**dev, "host_rss": rss}
+
+
+def estimate_zero_model_states_mem_needs(
+    total_params: int,
+    *,
+    stage: int,
+    data_shards: int,
+    compute_dtype_bytes: int = 2,
+    offload_optimizer: bool = False,
+    offload_params: bool = False,
+) -> Dict[str, float]:
+    """Per-device model-state memory (bytes) for a ZeRO stage.
+
+    Model states (the reference's accounting, fp32 Adam):
+      compute-dtype params (2B/param bf16), fp32 master (4B), fp32 grads
+      (4B), Adam m+v (8B). Stage decides which of those shard over the
+      ``data_shards`` axis (dp, or dp*fsdp when hpZ/MiCS sub-axes are on);
+      offload flags move the sharded state to host memory.
+    """
+    if stage not in (0, 1, 2, 3):
+        raise ValueError(f"stage must be 0-3, got {stage}")
+    n = float(total_params)
+    shards = float(max(data_shards, 1))
+
+    opt_bytes = n * (4 + 8)  # fp32 master + adam moments
+    grad_bytes = n * 4
+    param_bytes = n * compute_dtype_bytes
+
+    device = 0.0
+    host = 0.0
+    # optimizer states: sharded from stage 1
+    opt_local = opt_bytes / (shards if stage >= 1 else 1.0)
+    if offload_optimizer:
+        host += opt_local
+    else:
+        device += opt_local
+    # gradients: sharded from stage 2
+    device += grad_bytes / (shards if stage >= 2 else 1.0)
+    # parameters: sharded from stage 3
+    param_local = param_bytes / (shards if stage >= 3 else 1.0)
+    if offload_params and stage >= 3:
+        host += param_local
+    else:
+        device += param_local
+    return {
+        "device_bytes": device,
+        "host_bytes": host,
+        "device_gb": device / _GB,
+        "host_gb": host / _GB,
+    }
+
+
+def estimate_zero2_model_states_mem_needs(
+    total_params: int, data_shards: int, offload_optimizer: bool = False,
+) -> Dict[str, float]:
+    """Parity: estimate_zero2_model_states_mem_needs_all_live."""
+    return estimate_zero_model_states_mem_needs(
+        total_params, stage=2, data_shards=data_shards,
+        offload_optimizer=offload_optimizer,
+    )
+
+
+def estimate_zero3_model_states_mem_needs(
+    total_params: int, data_shards: int,
+    offload_optimizer: bool = False, offload_params: bool = False,
+) -> Dict[str, float]:
+    """Parity: estimate_zero3_model_states_mem_needs_all_live."""
+    return estimate_zero_model_states_mem_needs(
+        total_params, stage=3, data_shards=data_shards,
+        offload_optimizer=offload_optimizer, offload_params=offload_params,
+    )
+
+
+def print_zero_memory_estimates(
+    model, topology=None, stages=(0, 1, 2, 3), *,
+    compute_dtype_bytes: int = 2,
+    offload_optimizer: bool = False,
+    offload_params: bool = False,
+) -> None:
+    """Log a stage-by-stage table for a model on the current mesh, honoring
+    the run's offload + compute dtype (host-offloaded state is reported as
+    host GB, not device HBM)."""
+    n = model.num_params() if hasattr(model, "num_params") else int(model)
+    shards = topology.data_shard_size if topology is not None else 1
+    log_dist(
+        f"ZeRO memory estimates: {n / 1e6:.1f}M params, "
+        f"{shards} data shard(s)"
+    )
+    for stage in stages:
+        est = estimate_zero_model_states_mem_needs(
+            n, stage=stage, data_shards=shards,
+            compute_dtype_bytes=compute_dtype_bytes,
+            offload_optimizer=offload_optimizer,
+            offload_params=offload_params,
+        )
+        host = (
+            f" + {est['host_gb']:.2f}GB/host offloaded"
+            if est["host_bytes"] else ""
+        )
+        log_dist(
+            f"  stage {stage}: {est['device_gb']:.2f}GB/device model "
+            f"states{host}"
+        )

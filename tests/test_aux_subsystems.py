@@ -286,3 +286,68 @@ def test_launcher_all_success():
         subprocess.Popen([sys.executable, "-c", "pass"]) for _ in range(2)
     ]
     assert wait_and_propagate(procs, poll_s=0.05) == 0
+
+
+def test_zero_memory_estimators():
+    """ZeRO stage memory math (reference: estimate_zero{2,3}_..._mem_needs):
+    sharding divides exactly the states each stage shards."""
+    from deepspeed_tpu.utils import (
+        estimate_zero2_model_states_mem_needs,
+        estimate_zero3_model_states_mem_needs,
+        estimate_zero_model_states_mem_needs,
+    )
+
+    n, dp = 1_000_000, 8
+    s0 = estimate_zero_model_states_mem_needs(n, stage=0, data_shards=dp)
+    s1 = estimate_zero_model_states_mem_needs(n, stage=1, data_shards=dp)
+    s2 = estimate_zero2_model_states_mem_needs(n, dp)
+    s3 = estimate_zero3_model_states_mem_needs(n, dp)
+    # stage 0: 2 + 4 + 12 bytes/param all resident
+    assert s0["device_bytes"] == n * 18
+    # stage 1 shards the 12B optimizer states
+    assert s1["device_bytes"] == n * (2 + 4 + 12 / dp)
+    # stage 2 also shards fp32 grads
+    assert s2["device_bytes"] == n * (2 + 4 / dp + 12 / dp)
+    # stage 3 shards everything
+    assert abs(s3["device_bytes"] - n * 18 / dp) < 1
+    # offload moves the sharded states to host
+    s3o = estimate_zero3_model_states_mem_needs(
+        n, dp, offload_optimizer=True, offload_params=True
+    )
+    assert s3o["host_bytes"] == s3o["host_gb"] * (1 << 30)
+    assert s3o["device_bytes"] == n * 4 / dp  # only sharded grads stay
+
+
+def test_see_memory_usage_runs():
+    from deepspeed_tpu.utils import see_memory_usage
+
+    out = see_memory_usage("unit-test", force=True)
+    assert "bytes_in_use" in out and "host_rss" in out
+    assert see_memory_usage("skipped", force=False) == {}
+
+
+def test_memory_breakdown_config_wired(devices8, monkeypatch):
+    """ds_config memory_breakdown must actually report (r1 advisor bug
+    class: config parses then silently ignored)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.utils.memory as mem
+    from deepspeed_tpu.models import gpt2
+
+    calls = []
+    monkeypatch.setattr(
+        mem, "see_memory_usage",
+        lambda msg="", force=True: calls.append(msg) or {},
+    )
+    model = gpt2("gpt2-tiny", vocab_size=128, max_seq_len=32, hidden_size=32,
+                 num_layers=1, num_heads=2, intermediate_size=64)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": 8, "steps_per_print": 1,
+                "memory_breakdown": True,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+    )
+    assert any("init" in c for c in calls)
+    engine.train_batch(
+        batch={"input_ids": np.random.RandomState(0).randint(0, 128, size=(8, 32))}
+    )
+    assert any(c.startswith("step") for c in calls)
