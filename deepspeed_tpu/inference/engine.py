@@ -199,19 +199,53 @@ class InferenceEngine:
             )
             return logits[:, -1], cache
 
-        def sample(logits, key, temperature, top_k):
+        def _apply_repetition_penalty(logits, tokens_buf, pos, penalty):
+            """HF-convention penalty on every token generated/seen so far:
+            positive logits divide by the penalty, negative multiply."""
+            V = logits.shape[-1]
+            positions = jnp.arange(tokens_buf.shape[1])
+            live = positions[None, :] <= pos  # prompt + generated so far
+            seen = jnp.zeros((B, V), jnp.bool_).at[
+                jnp.arange(B)[:, None], tokens_buf
+            ].max(live)
+            penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+            return jnp.where(seen, penalized, logits)
+
+        def sample(logits, key, temperature, top_k, top_p):
             logits = logits / jnp.maximum(temperature, 1e-6)
             if top_k > 0:
                 kth = lax.top_k(logits, top_k)[0][:, -1][:, None]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            if top_p < 1.0:
+                # nucleus: keep the smallest prefix of the sorted distribution
+                # whose mass reaches top_p (the top-1 token always survives)
+                sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = (cum - probs) < top_p
+                keep = keep.at[:, 0].set(True)  # top-1 survives even top_p=0
+                kth = jnp.min(
+                    jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+                )
                 logits = jnp.where(logits < kth, -1e30, logits)
             greedy = jnp.argmax(logits, axis=-1)
             sampled = jax.random.categorical(key, logits, axis=-1)
             return jnp.where(temperature == 0.0, greedy, sampled)
 
-        def generate(params, tokens_buf, rng, temperature, top_k, eos_id):
+        def generate(params, tokens_buf, rng, temperature, top_k, top_p,
+                     rep_penalty, use_penalty, eos_id):
+            def step_sample(logits, tokens_buf, pos, key):
+                if use_penalty:
+                    logits = _apply_repetition_penalty(
+                        logits, tokens_buf, pos, rep_penalty
+                    )
+                return sample(logits, key, temperature, top_k, top_p)
+
             last_logits, cache = prefill(params, tokens_buf)
             key, rng = jax.random.split(rng)
-            nxt = sample(last_logits, key, temperature, top_k)
+            nxt = step_sample(
+                last_logits, tokens_buf, jnp.asarray(prompt_len - 1), key
+            )
             tokens_buf = lax.dynamic_update_slice(
                 tokens_buf, nxt[:, None], (0, prompt_len)
             )
@@ -228,7 +262,7 @@ class InferenceEngine:
                     self.config, params, tok, cache, pos, dtype=self.dtype
                 )
                 key, rng = jax.random.split(rng)
-                nxt = sample(logits[:, -1], key, temperature, top_k)
+                nxt = step_sample(logits[:, -1], tokens_buf, pos, key)
                 nxt = jnp.where(done, jnp.full_like(nxt, eos_id), nxt)
                 tokens_buf = lax.dynamic_update_slice(
                     tokens_buf, nxt[:, None], (0, pos + 1)
@@ -241,7 +275,9 @@ class InferenceEngine:
             )
             return tokens_buf
 
-        return jax.jit(generate, static_argnums=(4,))  # top_k gates a sort
+        # top_k/top_p/use_penalty static (each gates a sort/scatter); the
+        # penalty VALUE stays traced so sweeping it doesn't recompile
+        return jax.jit(generate, static_argnums=(4, 5, 7))
 
     def generate(
         self,
@@ -249,10 +285,13 @@ class InferenceEngine:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         top_k: int = 0,
+        top_p: float = 1.0,
+        repetition_penalty: float = 1.0,
         eos_token_id: int = -1,
         rng: Optional[jax.Array] = None,
     ):
-        """Greedy (temperature=0) or top-k sampled decoding.
+        """Greedy (temperature=0) or top-k / top-p sampled decoding, with
+        an optional HF-convention repetition penalty.
 
         Returns [B, prompt + max_new_tokens] token ids (eos-padded).
         """
@@ -279,6 +318,9 @@ class InferenceEngine:
                 rng if rng is not None else jax.random.PRNGKey(0),
                 jnp.asarray(temperature, jnp.float32),
                 top_k,
+                float(top_p),
+                jnp.asarray(repetition_penalty, jnp.float32),
+                float(repetition_penalty) != 1.0,
                 eos_token_id,
             )
         return np.asarray(out)

@@ -268,3 +268,49 @@ def test_int4_weight_only_inference():
     agree = (lf.argmax(-1) == l4.argmax(-1)).mean()
     assert agree > 0.7, agree
     assert np.max(np.abs(lf - l4)) < 2.0
+
+
+def test_generate_top_p_and_repetition_penalty():
+    """top-p keeps outputs in-vocab and deterministic seeds reproduce;
+    repetition_penalty discourages repeats vs the unpenalized run."""
+    import deepspeed_tpu
+
+    model = tiny_llama()
+    engine = deepspeed_tpu.init_inference(model, max_tokens=64)
+    prompt = np.random.RandomState(0).randint(0, model.config.vocab_size,
+                                              size=(2, 8))
+    out1 = engine.generate(prompt, max_new_tokens=8, temperature=0.8,
+                           top_p=0.9, rng=jax.random.PRNGKey(1))
+    out2 = engine.generate(prompt, max_new_tokens=8, temperature=0.8,
+                           top_p=0.9, rng=jax.random.PRNGKey(1))
+    assert (out1 == out2).all()  # same seed, same nucleus
+    assert out1.shape == (2, 16)
+    assert (out1 >= 0).all() and (out1 < model.config.vocab_size).all()
+
+    pen = engine.generate(prompt, max_new_tokens=8, temperature=0.0,
+                          repetition_penalty=5.0)
+    base = engine.generate(prompt, max_new_tokens=8, temperature=0.0)
+
+    def repeats(seq):
+        gen = seq[:, 8:]
+        return sum(
+            len(row) - len(set(row.tolist())) for row in gen
+        )
+
+    # a strong penalty can only reduce (or keep) the repeat count
+    assert repeats(pen) <= repeats(base)
+
+
+def test_generate_top_p_zero_still_greedyish():
+    """top_p=0 must keep the top-1 token (no silent uniform sampling)."""
+    import deepspeed_tpu
+
+    model = tiny_llama()
+    engine = deepspeed_tpu.init_inference(model, max_tokens=32)
+    prompt = np.random.RandomState(1).randint(0, model.config.vocab_size,
+                                              size=(1, 8))
+    greedy = engine.generate(prompt, max_new_tokens=6, temperature=0.0)
+    nucleus0 = engine.generate(prompt, max_new_tokens=6, temperature=0.5,
+                               top_p=0.0, rng=jax.random.PRNGKey(0))
+    # with only the top-1 token surviving, sampling == greedy
+    assert (nucleus0 == greedy).all()
