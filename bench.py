@@ -34,6 +34,14 @@ def peak_flops_per_chip() -> float:
 
 def main():
     import jax
+
+    try:
+        # warm restarts of the driver reuse compiled programs (best-effort;
+        # harmless when the backend compiles remotely)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/dstpu_jaxcache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
 

@@ -35,6 +35,13 @@ from ..ops.quantizer import quantize_dequantize
 from ..utils.logging import log_dist
 
 
+def apply_repetition_penalty(logits, seen, penalty):
+    """HF-convention repetition penalty: for tokens in ``seen`` [B, V],
+    positive logits divide by the penalty, negative multiply."""
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
 def init_inference(
     model,
     tensor_parallel: Optional[Dict[str, Any]] = None,
@@ -199,18 +206,6 @@ class InferenceEngine:
             )
             return logits[:, -1], cache
 
-        def _apply_repetition_penalty(logits, tokens_buf, pos, penalty):
-            """HF-convention penalty on every token generated/seen so far:
-            positive logits divide by the penalty, negative multiply."""
-            V = logits.shape[-1]
-            positions = jnp.arange(tokens_buf.shape[1])
-            live = positions[None, :] <= pos  # prompt + generated so far
-            seen = jnp.zeros((B, V), jnp.bool_).at[
-                jnp.arange(B)[:, None], tokens_buf
-            ].max(live)
-            penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
-            return jnp.where(seen, penalized, logits)
-
         def sample(logits, key, temperature, top_k, top_p):
             logits = logits / jnp.maximum(temperature, 1e-6)
             if top_k > 0:
@@ -234,44 +229,59 @@ class InferenceEngine:
 
         def generate(params, tokens_buf, rng, temperature, top_k, top_p,
                      rep_penalty, use_penalty, eos_id):
-            def step_sample(logits, tokens_buf, pos, key):
+            V = cfg.vocab_size
+            rows = jnp.arange(B)
+
+            def step_sample(logits, seen, key):
                 if use_penalty:
-                    logits = _apply_repetition_penalty(
-                        logits, tokens_buf, pos, rep_penalty
-                    )
+                    logits = apply_repetition_penalty(logits, seen, rep_penalty)
                 return sample(logits, key, temperature, top_k, top_p)
+
+            # seen-token mask carried through the loop: built once from the
+            # prompt, then one O(B) scatter per generated token (not a full
+            # (B,V) rebuild per step)
+            if use_penalty:
+                prompt_live = jnp.arange(total_len)[None, :] < prompt_len
+                seen = jnp.zeros((B, V), jnp.bool_).at[
+                    rows[:, None], tokens_buf
+                ].max(prompt_live)
+            else:
+                seen = jnp.zeros((B, 1), jnp.bool_)  # unused placeholder
 
             last_logits, cache = prefill(params, tokens_buf)
             key, rng = jax.random.split(rng)
-            nxt = step_sample(
-                last_logits, tokens_buf, jnp.asarray(prompt_len - 1), key
-            )
+            nxt = step_sample(last_logits, seen, key)
+            if use_penalty:
+                seen = seen.at[rows, nxt].set(True)
             tokens_buf = lax.dynamic_update_slice(
                 tokens_buf, nxt[:, None], (0, prompt_len)
             )
             done = nxt == eos_id
 
             def cond(state):
-                _, _, pos, _, done = state
+                _, _, pos, _, done, _ = state
                 return (pos < total_len - 1) & ~jnp.all(done)
 
             def body(state):
-                tokens_buf, cache, pos, rng, done = state
+                tokens_buf, cache, pos, rng, done, seen = state
                 tok = lax.dynamic_slice(tokens_buf, (0, pos), (B, 1))
                 logits, cache = forward_with_cache(
                     self.config, params, tok, cache, pos, dtype=self.dtype
                 )
                 key, rng = jax.random.split(rng)
-                nxt = step_sample(logits[:, -1], tokens_buf, pos, key)
+                nxt = step_sample(logits[:, -1], seen, key)
                 nxt = jnp.where(done, jnp.full_like(nxt, eos_id), nxt)
+                if use_penalty:
+                    seen = seen.at[rows, nxt].set(True)
                 tokens_buf = lax.dynamic_update_slice(
                     tokens_buf, nxt[:, None], (0, pos + 1)
                 )
                 done = done | (nxt == eos_id)
-                return (tokens_buf, cache, pos + 1, rng, done)
+                return (tokens_buf, cache, pos + 1, rng, done, seen)
 
-            tokens_buf, _, _, _, _ = lax.while_loop(
-                cond, body, (tokens_buf, cache, jnp.asarray(prompt_len), rng, done)
+            tokens_buf, _, _, _, _, _ = lax.while_loop(
+                cond, body,
+                (tokens_buf, cache, jnp.asarray(prompt_len), rng, done, seen),
             )
             return tokens_buf
 
@@ -297,6 +307,10 @@ class InferenceEngine:
         """
         ids = np.asarray(input_ids)
         B, prompt_len = ids.shape
+        if max_new_tokens <= 0:
+            # nothing to generate: echo the prompt (the decode program would
+            # otherwise clamp its first write onto the last prompt token)
+            return ids.astype(np.int32)
         if prompt_len >= self.max_tokens:
             raise ValueError(
                 f"prompt length {prompt_len} leaves no room to generate under "

@@ -124,3 +124,37 @@ def test_chunked_ce_ragged_vocab_matches_dense():
     for a, b in zip(g_got, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_fused_ce_with_fp16_loss_scaling():
+    """The chunked-CE custom VJP must propagate the scaled-loss cotangent
+    exactly like the dense path (fp16 dynamic loss scaling multiplies the
+    loss before grad)."""
+    from deepspeed_tpu.models import llama
+
+    def run(fused):
+        model = llama(
+            "llama-tiny", vocab_size=256, max_seq_len=64, hidden_size=64,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            intermediate_size=128,
+        )
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+                "fp16": {"enabled": True, "initial_scale_power": 8},
+                "zero_optimization": {"stage": 1},
+                "tpu_kernels": {"fused_ce": fused, "ce_chunk": 64},
+            },
+            rng=jax.random.PRNGKey(0),
+        )
+        batch = {
+            "input_ids": np.random.RandomState(0).randint(0, 256, size=(8, 64))
+        }
+        return [float(engine.train_batch(batch=batch)) for _ in range(4)]
+
+    fused = run(True)
+    dense = run(False)
+    assert np.isfinite(fused).all()
+    np.testing.assert_allclose(fused, dense, rtol=2e-3)
