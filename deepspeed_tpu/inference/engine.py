@@ -50,6 +50,7 @@ def init_inference(
     replace_with_kernel_inject: bool = False,
     quantize_bits: Optional[int] = None,
     max_tokens: int = 1024,
+    kv_cache_dtype: str = "auto",
     checkpoint=None,
     topology: Optional[MeshTopology] = None,
     params=None,
@@ -91,6 +92,7 @@ def init_inference(
         kernel_inject=replace_with_kernel_inject,
         quantize_bits=quantize_bits,
         max_tokens=max_tokens,
+        kv_cache_dtype=kv_cache_dtype,
         params=params,
         rng=rng,
     )
@@ -105,6 +107,7 @@ class InferenceEngine:
         kernel_inject: bool = False,
         quantize_bits: Optional[int] = None,
         max_tokens: int = 1024,
+        kv_cache_dtype: str = "auto",
         params=None,
         rng: Optional[jax.Array] = None,
     ):
@@ -114,6 +117,17 @@ class InferenceEngine:
         self.dtype = dtype
         self.max_tokens = min(max_tokens, self.config.max_seq_len)
         self.kernel_inject = kernel_inject
+        # int8 KV cache: halves KV HBM for long-context serving; per-token
+        # scales dequantize at read (in-kernel on the Pallas decode path)
+        if kv_cache_dtype not in ("auto", "int8", "bf16", "bfloat16"):
+            raise ValueError(
+                f"kv_cache_dtype must be auto|bf16|bfloat16|int8, got "
+                f"{kv_cache_dtype!r}"
+            )
+        self.kv_cache_quantized = kv_cache_dtype == "int8"
+        self.kv_cache_storage_dtype = (
+            jnp.bfloat16 if kv_cache_dtype in ("bf16", "bfloat16") else dtype
+        )
         # "kernel injection" parity (reference: replace_with_kernel_inject
         # swaps torch blocks for fused CUDA blocks, csrc/transformer/
         # inference). The TPU translation is a fused *composition*, not one
@@ -199,7 +213,10 @@ class InferenceEngine:
         cfg = self.config
 
         def prefill(params, tokens_buf):
-            cache = init_cache(cfg, B, total_len, self.dtype)
+            cache = init_cache(
+                cfg, B, total_len, self.kv_cache_storage_dtype,
+                quantized=self.kv_cache_quantized,
+            )
             prompt = tokens_buf[:, :prompt_len]
             logits, cache = forward_with_cache(
                 cfg, params, prompt, cache, 0, dtype=self.dtype

@@ -32,15 +32,38 @@ from .transformer import (
 
 Cache = Dict[str, jax.Array]
 
+SCALE_LANES = 8  # redundant scale copies (min sublane tile; kernels read col 0)
+
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
-               dtype=jnp.bfloat16) -> Cache:
-    """Static KV ring buffer for all layers."""
+               dtype=jnp.bfloat16, quantized: bool = False) -> Cache:
+    """Static KV ring buffer for all layers.
+
+    quantized: int8 storage with per-(token, kv-head) fp32 absmax scales —
+    halves KV HBM for long-context serving (reference: kv-cache quant in
+    the inference engine family). Dequant happens at read (in-kernel on the
+    Pallas decode path)."""
     shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.hd)
+    if quantized:
+        sshape = (cfg.num_layers, batch, max_len, cfg.kv_heads, SCALE_LANES)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
     }
+
+
+def _quantize_kv(t: jax.Array):
+    """[B,S,KV,hd] → (int8 values, [B,S,KV,SCALE_LANES] fp32 scales)."""
+    s = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return q, jnp.broadcast_to(s, (*s.shape[:-1], SCALE_LANES))
 
 
 def _qkv(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array):
@@ -60,23 +83,41 @@ def _qkv(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array):
 
 def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
                       positions: jax.Array, k_cache: jax.Array,
-                      v_cache: jax.Array, cache_len) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                      v_cache: jax.Array, cache_len,
+                      k_scale=None, v_scale=None):
     """Attend new tokens (x, [B,S,D]) against cache[:cache_len] + themselves.
 
-    Returns (out, new_k_cache, new_v_cache). Works for prefill (S=prompt,
-    cache_len=0) and decode (S=1, cache_len=pos).
+    Returns (out, new_k_cache, new_v_cache[, new_k_scale, new_v_scale]).
+    Works for prefill (S=prompt, cache_len=0) and decode (S=1,
+    cache_len=pos). int8 caches carry per-(token, head) scales; the fresh
+    prefill attends with the exact (unquantized) new k/v — only reads from
+    the cache dequantize.
     """
     B, S, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
     S_max = k_cache.shape[1]
     q, k, v = _qkv(cfg, p, x, positions)
 
-    k_cache = lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
-    )
-    v_cache = lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
-    )
+    quantized = k_scale is not None
+    if quantized:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        k_cache = lax.dynamic_update_slice(k_cache, kq, (0, cache_len, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, vq, (0, cache_len, 0, 0))
+        k_scale = lax.dynamic_update_slice(k_scale, ks, (0, cache_len, 0, 0))
+        v_scale = lax.dynamic_update_slice(v_scale, vs, (0, cache_len, 0, 0))
+    else:
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
+        )
+
+    def ret(out):
+        if quantized:
+            return out, k_cache, v_cache, k_scale, v_scale
+        return out, k_cache, v_cache
 
     if isinstance(cache_len, int) and cache_len == 0 and S > 1:
         # fresh prefill: the new tokens only attend among themselves, so the
@@ -96,7 +137,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
         out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
         if cfg.use_bias:
             out = out + p["bo"]
-        return out, k_cache, v_cache
+        return ret(out)
     if S == 1 and cfg.pos_embedding != "alibi":
         # fused decode path (kernel injection): Pallas cached-KV attention
         # when the registered impl is the kernel one and shapes fit
@@ -105,16 +146,22 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
         if _resolve() == "flash":
             from ..ops.pallas.decode_attention import decode_attention
 
-            out = decode_attention(q, k_cache, v_cache, cache_len)
+            out = decode_attention(
+                q, k_cache, v_cache, cache_len,
+                k_scale=k_scale, v_scale=v_scale,
+            )
             if out is not None:
                 out = out.astype(x.dtype).reshape(B, S, nh * hd)
                 out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
                 if cfg.use_bias:
                     out = out + p["bo"]
-                return out, k_cache, v_cache
+                return ret(out)
 
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
+    if quantized:
+        kf = kf * k_scale[..., :1]
+        vf = vf * v_scale[..., :1]
     if nkv != nh:
         kf = jnp.repeat(kf, nh // nkv, axis=2)
         vf = jnp.repeat(vf, nh // nkv, axis=2)
@@ -135,7 +182,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
     if cfg.use_bias:
         out = out + p["bo"]
-    return out, k_cache, v_cache
+    return ret(out)
 
 
 def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
@@ -160,21 +207,40 @@ def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Ar
 
     layers = cast(params["layers"])
 
+    quantized = "k_scale" in cache
+
     def body(carry, scanned):
         h = carry
-        layer, kc, vc = scanned
-        a, kc, vc = _cached_attention(
-            cfg, layer["attn"], _norm(cfg, layer["ln1"], h), positions, kc, vc,
-            cache_len,
-        )
+        if quantized:
+            layer, kc, vc, ks, vs = scanned
+            a, kc, vc, ks, vs = _cached_attention(
+                cfg, layer["attn"], _norm(cfg, layer["ln1"], h), positions,
+                kc, vc, cache_len, ks, vs,
+            )
+            new_cache = (kc, vc, ks, vs)
+        else:
+            layer, kc, vc = scanned
+            a, kc, vc = _cached_attention(
+                cfg, layer["attn"], _norm(cfg, layer["ln1"], h), positions,
+                kc, vc, cache_len,
+            )
+            new_cache = (kc, vc)
         h = h + a
         normed = _norm(cfg, layer["ln2"], h)
         m, _aux = _mlp(cfg, layer["mlp"], normed, rng=None, train=False)
         h = h + m
         h = constrain(h, ("dp", "fsdp"), None, None)
-        return h, (kc, vc)
+        return h, new_cache
 
-    x, (k_new, v_new) = lax.scan(body, x, (layers, cache["k"], cache["v"]))
+    if quantized:
+        scanned = (layers, cache["k"], cache["v"], cache["k_scale"],
+                   cache["v_scale"])
+        x, (k_new, v_new, ks_new, vs_new) = lax.scan(body, x, scanned)
+        new_cache = {"k": k_new, "v": v_new, "k_scale": ks_new,
+                     "v_scale": vs_new}
+    else:
+        x, (k_new, v_new) = lax.scan(body, x, (layers, cache["k"], cache["v"]))
+        new_cache = {"k": k_new, "v": v_new}
     x = _norm(cfg, cast(params["final_norm"]), x)
     logits = lm_head_logits(cfg, params, x)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, new_cache

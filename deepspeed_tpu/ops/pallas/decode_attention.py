@@ -28,8 +28,13 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_S = 256
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, cl_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, scale, block_s):
+def _decode_kernel(*refs, scale, block_s, has_scales=False):
+    if has_scales:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, cl_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, cl_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     si = pl.program_id(2)
     ns = pl.num_programs(2)
     cl = cl_ref[0, 0]  # new token's position == number of cached tokens
@@ -47,6 +52,10 @@ def _decode_kernel(q_ref, k_ref, v_ref, cl_ref, o_ref, m_scr, l_scr, acc_scr,
         q = q_ref[0, 0]  # [G, hd]
         k = k_ref[0, :, 0, :]  # [block_s, hd] (storage dtype)
         v = v_ref[0, :, 0, :]
+        if has_scales:
+            # int8 cache: dequantize the tile with its per-token scales
+            k = (k.astype(jnp.float32) * ks_ref[0, :, 0, :][:, :1]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[0, :, 0, :][:, :1]).astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [G, block_s]
@@ -82,13 +91,15 @@ def _pick_block(S: int, preferred: int) -> Optional[int]:
 
 
 def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
+                            k_scale=None, v_scale=None,
                             block_s: int = DEFAULT_BLOCK_S,
                             interpret: Optional[bool] = None):
     """q [B,1,H,hd] new-token queries vs k/v_cache [B,Smax,KV,hd].
 
     cache_len: scalar int32 — the new token's position (tokens already
     cached). Returns [B,1,H,hd]. Caller guarantees the new token's k/v are
-    already written at ``cache_len``.
+    already written at ``cache_len``. int8 caches pass per-token scales
+    [B,Smax,KV,SCALE_LANES]; dequant happens on the tile in VMEM.
     """
     B, one, H, hd = q.shape
     assert one == 1, "decode kernel is single-token"
@@ -101,16 +112,32 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
     qg = q.reshape(B, KV, G, hd)
     cl = jnp.reshape(cache_len, (1, 1)).astype(jnp.int32)
     ns = Smax // bs
+    has_scales = k_scale is not None
+
+    operands = [qg, k_cache, v_cache]
+    in_specs = [
+        pl.BlockSpec((1, 1, G, hd), lambda b, kv, si: (b, kv, 0, 0)),
+        pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
+        pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
+    ]
+    if has_scales:
+        SL = k_scale.shape[-1]
+        operands += [k_scale, v_scale]
+        in_specs += [
+            pl.BlockSpec((1, bs, 1, SL), lambda b, kv, si: (b, si, kv, 0)),
+            pl.BlockSpec((1, bs, 1, SL), lambda b, kv, si: (b, si, kv, 0)),
+        ]
+    operands.append(cl)
+    in_specs.append(
+        pl.BlockSpec((1, 1), lambda b, kv, si: (0, 0), memory_space=pltpu.SMEM)
+    )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_s=bs),
+        functools.partial(
+            _decode_kernel, scale=scale, block_s=bs, has_scales=has_scales
+        ),
         grid=(B, KV, ns),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, hd), lambda b, kv, si: (b, kv, 0, 0)),
-            pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
-            pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
-            pl.BlockSpec((1, 1), lambda b, kv, si: (0, 0), memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, kv, si: (b, kv, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
         scratch_shapes=[
@@ -122,11 +149,12 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qg, k_cache, v_cache, cl)
+    )(*operands)
     return out.reshape(B, 1, H, hd)
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, *,
+                     k_scale=None, v_scale=None,
                      interpret: Optional[bool] = None):
     """Shard-map-aware wrapper: cache heads over tp, batch over dp/fsdp —
     mirrors flash_attention's serving layout. Returns None if the shapes
@@ -150,7 +178,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
 
     if not distributed:
         return decode_attention_kernel(
-            q, k_cache, v_cache, cache_len, interpret=interpret
+            q, k_cache, v_cache, cache_len,
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret,
         )
 
     from jax import shard_map
@@ -159,19 +188,35 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
     batch_axes = tuple(a for a in ("dp", "fsdp") if topo.sizes[a] > 1)
     b_ax = batch_axes if batch_axes else None
     h_ax = "tp" if tp > 1 else None
+    has_scales = k_scale is not None
+    # scales are [B, Smax, KV, SCALE_LANES]: head dim 2 follows tp
+    kv_spec = P(b_ax, None, h_ax, None)
+    dummy = jnp.zeros((1, 1, 1, 1), jnp.float32)
 
-    def body(q, kc, vc, cl):
-        return decode_attention_kernel(q, kc, vc, cl, interpret=interpret)
+    def body(q, kc, vc, ks, vs, cl):
+        return decode_attention_kernel(
+            q, kc, vc, cl,
+            k_scale=ks if has_scales else None,
+            v_scale=vs if has_scales else None,
+            interpret=interpret,
+        )
 
     return shard_map(
         body,
         mesh=topo.mesh,
         in_specs=(
             P(b_ax, None, h_ax, None),
-            P(b_ax, None, h_ax, None),
-            P(b_ax, None, h_ax, None),
+            kv_spec,
+            kv_spec,
+            kv_spec if has_scales else P(None, None, None, None),
+            kv_spec if has_scales else P(None, None, None, None),
             P(),
         ),
         out_specs=P(b_ax, None, h_ax, None),
         check_vma=False,
-    )(q, k_cache, v_cache, jnp.asarray(cache_len, jnp.int32))
+    )(
+        q, k_cache, v_cache,
+        k_scale if has_scales else dummy,
+        v_scale if has_scales else dummy,
+        jnp.asarray(cache_len, jnp.int32),
+    )

@@ -330,3 +330,84 @@ def test_generate_top_p_zero_still_greedyish():
                                top_p=0.0, rng=jax.random.PRNGKey(0))
     # with only the top-1 token surviving, sampling == greedy
     assert (nucleus0 == greedy).all()
+
+
+def test_int8_kv_cache_decode_close_to_bf16():
+    """int8 KV cache (kv_cache_dtype="int8"): generate runs end-to-end and
+    per-step decode logits stay close to the full-precision cache."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.decoding import forward_with_cache, init_cache
+
+    model = tiny_llama()
+    cfg = model.config
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, cfg.vocab_size, size=(2, 12))
+    )
+
+    # prefill + one decode step on both cache flavors
+    def run(quantized):
+        cache = init_cache(cfg, 2, 32, jnp.float32, quantized=quantized)
+        logits, cache = forward_with_cache(
+            cfg, params, prompt, cache, 0, dtype=jnp.float32
+        )
+        nxt = logits[:, -1].argmax(-1)[:, None]
+        step_logits, cache = forward_with_cache(
+            cfg, params, nxt, cache, 12, dtype=jnp.float32
+        )
+        return np.asarray(logits[:, -1]), np.asarray(step_logits[:, -1])
+
+    pre_f, dec_f = run(False)
+    pre_q, dec_q = run(True)
+    # prefill attends with exact new k/v: identical
+    np.testing.assert_allclose(pre_q, pre_f, rtol=1e-5, atol=1e-5)
+    # decode reads the quantized cache: close, and top-1 agrees
+    np.testing.assert_allclose(dec_q, dec_f, rtol=0.2, atol=0.15)
+    assert (dec_q.argmax(-1) == dec_f.argmax(-1)).mean() >= 0.5
+
+    # engine-level: int8 cache generates in-vocab tokens deterministically
+    engine = deepspeed_tpu.init_inference(
+        model, max_tokens=32, kv_cache_dtype="int8",
+        replace_with_kernel_inject=True,
+    )
+    out = engine.generate(np.asarray(prompt), max_new_tokens=6)
+    out2 = engine.generate(np.asarray(prompt), max_new_tokens=6)
+    assert (out == out2).all()
+    assert out.shape == (2, 18) and (out < cfg.vocab_size).all()
+
+
+def test_int8_kv_cache_halves_cache_bytes():
+    from deepspeed_tpu.models.decoding import init_cache
+
+    from deepspeed_tpu.models import llama
+
+    cfg = llama(
+        "llama-tiny", vocab_size=256, max_seq_len=128, hidden_size=256,
+        num_layers=2, num_heads=2, num_kv_heads=2, head_dim=128,
+        intermediate_size=256,
+    ).config
+    full = init_cache(cfg, 1, 128, jnp.bfloat16, quantized=False)
+    quant = init_cache(cfg, 1, 128, jnp.bfloat16, quantized=True)
+    data_bytes = lambda c: c["k"].nbytes + c["v"].nbytes
+    assert data_bytes(quant) == data_bytes(full) // 2
+    # scale overhead (32B/token-head) stays small next to hd=128 int8 data
+    scale_bytes = quant["k_scale"].nbytes + quant["v_scale"].nbytes
+    assert scale_bytes == data_bytes(quant) // 4
+
+
+def test_kv_cache_dtype_bf16_honored():
+    """kv_cache_dtype="bf16" on an fp32 engine must actually store bf16."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.decoding import init_cache
+
+    model = tiny_llama()
+    engine = deepspeed_tpu.init_inference(
+        model, dtype=jnp.float32, kv_cache_dtype="bf16", max_tokens=32
+    )
+    assert engine.kv_cache_storage_dtype == jnp.bfloat16
+    prompt = np.random.RandomState(4).randint(0, model.config.vocab_size,
+                                              size=(1, 8))
+    out = engine.generate(prompt, max_new_tokens=4)
+    assert out.shape == (1, 12)
+    with pytest.raises(ValueError):
+        deepspeed_tpu.init_inference(model, kv_cache_dtype="fp8")
