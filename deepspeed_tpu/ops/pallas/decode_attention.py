@@ -56,6 +56,11 @@ def _decode_kernel(*refs, scale, block_s, has_scales=False):
             # int8 cache: dequantize the tile with its per-token scales
             k = (k.astype(jnp.float32) * ks_ref[0, :, 0, :][:, :1]).astype(q.dtype)
             v = (v.astype(jnp.float32) * vs_ref[0, :, 0, :][:, :1]).astype(q.dtype)
+        elif k.dtype != q.dtype:
+            # mixed storage (kv_cache_dtype="bf16" on an fp32 engine): the
+            # MXU matmul needs matching operand dtypes
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [G, block_s]
@@ -191,32 +196,28 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
     has_scales = k_scale is not None
     # scales are [B, Smax, KV, SCALE_LANES]: head dim 2 follows tp
     kv_spec = P(b_ax, None, h_ax, None)
-    dummy = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    operands = [q, k_cache, v_cache]
+    in_specs = [P(b_ax, None, h_ax, None), kv_spec, kv_spec]
+    if has_scales:
+        operands += [k_scale, v_scale]
+        in_specs += [kv_spec, kv_spec]
+    operands.append(jnp.asarray(cache_len, jnp.int32))
+    in_specs.append(P())
 
-    def body(q, kc, vc, ks, vs, cl):
+    def body(q, kc, vc, *rest):
+        if has_scales:
+            ks, vs, cl = rest
+        else:
+            (cl,) = rest
+            ks = vs = None
         return decode_attention_kernel(
-            q, kc, vc, cl,
-            k_scale=ks if has_scales else None,
-            v_scale=vs if has_scales else None,
-            interpret=interpret,
+            q, kc, vc, cl, k_scale=ks, v_scale=vs, interpret=interpret
         )
 
     return shard_map(
         body,
         mesh=topo.mesh,
-        in_specs=(
-            P(b_ax, None, h_ax, None),
-            kv_spec,
-            kv_spec,
-            kv_spec if has_scales else P(None, None, None, None),
-            kv_spec if has_scales else P(None, None, None, None),
-            P(),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(b_ax, None, h_ax, None),
         check_vma=False,
-    )(
-        q, k_cache, v_cache,
-        k_scale if has_scales else dummy,
-        v_scale if has_scales else dummy,
-        jnp.asarray(cache_len, jnp.int32),
-    )
+    )(*operands)

@@ -411,3 +411,53 @@ def test_kv_cache_dtype_bf16_honored():
     assert out.shape == (1, 12)
     with pytest.raises(ValueError):
         deepspeed_tpu.init_inference(model, kv_cache_dtype="fp8")
+
+
+def test_decode_attention_kernel_int8_scales_in_kernel():
+    """The in-kernel dequant path (has_scales): Pallas output must match the
+    dequantize-then-matvec reference, incl. GQA and cache predication."""
+    from deepspeed_tpu.models.decoding import SCALE_LANES, _quantize_kv
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention_kernel
+
+    B, Smax, H, KV, hd = 2, 512, 4, 2, 64
+    r = np.random.RandomState(1)
+    q = jnp.asarray(r.randn(B, 1, H, hd), jnp.float32)
+    k_raw = jnp.asarray(r.randn(B, Smax, KV, hd), jnp.float32)
+    v_raw = jnp.asarray(r.randn(B, Smax, KV, hd), jnp.float32)
+    kq, ks = _quantize_kv(k_raw)
+    vq, vs = _quantize_kv(v_raw)
+    assert kq.dtype == jnp.int8 and ks.shape == (B, Smax, KV, SCALE_LANES)
+
+    for cache_len in (5, 130, 511):
+        out = decode_attention_kernel(
+            q, kq, vq, jnp.asarray(cache_len), k_scale=ks, v_scale=vs
+        )
+        kf = kq.astype(jnp.float32) * ks[..., :1]
+        vf = vq.astype(jnp.float32) * vs[..., :1]
+        kf = jnp.repeat(kf, H // KV, axis=2)
+        vf = jnp.repeat(vf, H // KV, axis=2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(hd)
+        kpos = jnp.arange(Smax)[None, None, None, :]
+        logits = jnp.where(kpos <= cache_len, logits, -1e30)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), vf)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_decode_attention_kernel_mixed_storage_dtype():
+    """bf16 cache vs fp32 queries (kv_cache_dtype="bf16" on an fp32 engine):
+    the kernel casts storage to the query dtype before the matmul."""
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention_kernel
+
+    B, Smax, H, KV, hd = 1, 256, 2, 2, 32
+    r = np.random.RandomState(2)
+    q = jnp.asarray(r.randn(B, 1, H, hd), jnp.float32)
+    kc = jnp.asarray(r.randn(B, Smax, KV, hd), jnp.float32).astype(jnp.bfloat16)
+    vc = jnp.asarray(r.randn(B, Smax, KV, hd), jnp.float32).astype(jnp.bfloat16)
+    out = decode_attention_kernel(q, kc, vc, jnp.asarray(64))
+    kf = kc.astype(jnp.float32)
+    vf = vc.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(hd)
+    kpos = jnp.arange(Smax)[None, None, None, :]
+    logits = jnp.where(kpos <= 64, logits, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), vf)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-3)

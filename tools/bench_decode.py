@@ -25,6 +25,9 @@ def main():
     ap.add_argument("--no-inject", action="store_true")
     ap.add_argument("--dtype", default="bf16",
                     choices=["bf16", "int8", "int4"])
+    ap.add_argument("--kv-cache", default="auto",
+                    choices=["auto", "bf16", "int8"],
+                    help="KV cache storage (int8: quantized, half HBM)")
     ap.add_argument("--new-tokens", type=int, default=128)
     args = ap.parse_args()
 
@@ -50,6 +53,7 @@ def main():
         tp_size=1,
         dtype={"bf16": jnp.bfloat16, "int8": "int8", "int4": "int4"}[args.dtype],
         replace_with_kernel_inject=not args.no_inject,
+        kv_cache_dtype=args.kv_cache,
         max_tokens=256 if smoke else 2048,
     )
     B, prompt_len = 1, 16 if smoke else 128
@@ -80,6 +84,7 @@ def main():
                 "prefill_s": round(prefill_s, 4),
                 "new_tokens": new,
                 "dtype": args.dtype,
+                "kv_cache": args.kv_cache,
                 "kernel_inject": not args.no_inject,
                 "smoke": smoke,
             }
