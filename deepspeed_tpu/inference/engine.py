@@ -51,6 +51,8 @@ def init_inference(
     quantize_bits: Optional[int] = None,
     max_tokens: int = 1024,
     kv_cache_dtype: str = "auto",
+    draft_model=None,
+    draft_params=None,
     checkpoint=None,
     topology: Optional[MeshTopology] = None,
     params=None,
@@ -93,6 +95,8 @@ def init_inference(
         quantize_bits=quantize_bits,
         max_tokens=max_tokens,
         kv_cache_dtype=kv_cache_dtype,
+        draft_model=draft_model,
+        draft_params=draft_params,
         params=params,
         rng=rng,
     )
@@ -108,6 +112,8 @@ class InferenceEngine:
         quantize_bits: Optional[int] = None,
         max_tokens: int = 1024,
         kv_cache_dtype: str = "auto",
+        draft_model=None,
+        draft_params=None,
         params=None,
         rng: Optional[jax.Array] = None,
     ):
@@ -173,6 +179,22 @@ class InferenceEngine:
             )
             params = jax.device_put(params, shardings)
         self.params = params
+        # speculative decoding (greedy, B=1): a small draft model proposes,
+        # the main model verifies a whole window per forward
+        self.draft_model = draft_model
+        self.draft_params = None
+        if draft_model is not None:
+            if draft_model.config.vocab_size != self.config.vocab_size:
+                raise ValueError(
+                    "draft model must share the main model's vocabulary "
+                    f"({draft_model.config.vocab_size} != "
+                    f"{self.config.vocab_size})"
+                )
+            if draft_params is None:
+                draft_params = draft_model.init(
+                    jax.random.PRNGKey(1), dtype=dtype
+                )
+            self.draft_params = jax.tree.map(cast, draft_params)
         self._decode_fns: Dict[int, Any] = {}
         n_params = sum(
             int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
@@ -207,6 +229,116 @@ class InferenceEngine:
         return logits
 
     __call__ = forward
+
+    # -------------------------------------------------- speculative decode
+    def _build_spec_decode(self, prompt_len: int, total_len: int, k: int):
+        """Greedy speculative decoding, B=1 (the latency-bound serving case).
+
+        Reference-era DeepSpeed ships this in its serving stack; TPU-native
+        form: ONE jitted program — a small draft model proposes k-1 tokens
+        autoregressively, the main model scores the whole window in a single
+        cached forward, and the longest matching prefix (+1 "bonus" token
+        from the verifier) is accepted. Greedy acceptance makes the output
+        token-for-token IDENTICAL to plain greedy decoding of the main
+        model — the oracle the tests assert — while the main model runs
+        ~new_tokens/(accepted+1) times instead of new_tokens times.
+
+        Cache discipline: every verify writes its full k-token window at the
+        accepted position, so entries from rejected drafts are always
+        overwritten before any later query can attend them (windows are
+        contiguous and advance by >= 1 per round).
+        """
+        cfg = self.config
+        dcfg = self.draft_model.config
+        total_alloc = total_len + k  # margin so last-round writes stay in-bounds
+
+        def spec_generate(params, dparams, tokens_buf, eos_id):
+            main_cache = init_cache(
+                cfg, 1, total_alloc, self.kv_cache_storage_dtype,
+                quantized=self.kv_cache_quantized,
+            )
+            draft_cache = init_cache(dcfg, 1, total_alloc, self.dtype)
+            prompt = tokens_buf[:, :prompt_len]
+            logits, main_cache = forward_with_cache(
+                cfg, params, prompt, main_cache, 0, dtype=self.dtype
+            )
+            n0 = jnp.argmax(logits[:, -1], axis=-1)  # token at position P
+            tokens_buf = lax.dynamic_update_slice(
+                tokens_buf, n0[:, None], (0, prompt_len)
+            )
+            _, draft_cache = forward_with_cache(
+                dcfg, dparams, prompt, draft_cache, 0, dtype=self.dtype
+            )
+
+            def cond(state):
+                _, _, _, pos, done, _ = state
+                return (pos < total_len - 1) & ~done
+
+            def body(state):
+                tokens_buf, main_cache, draft_cache, pos, done, rounds = state
+                # --- draft k-1 tokens autoregressively ------------------
+                # the loop runs k steps (one past the last proposal): the
+                # extra step's token is discarded but its forward writes the
+                # draft-cache row at pos+k-1, which a fully-accepting round
+                # (adv = k) would otherwise leave as zeros forever —
+                # collapsing acceptance for the rest of the generation
+                start_tok = lax.dynamic_slice(tokens_buf, (0, pos), (1, 1))
+                cand0 = jnp.zeros((1, k + 1), jnp.int32)
+                cand0 = lax.dynamic_update_slice(cand0, start_tok, (0, 0))
+
+                def dstep(i, carry):
+                    cand, dcache = carry
+                    tok = lax.dynamic_slice(cand, (0, i), (1, 1))
+                    dlog, dcache = forward_with_cache(
+                        dcfg, dparams, tok, dcache, pos + i, dtype=self.dtype
+                    )
+                    nxt = jnp.argmax(dlog[:, -1], axis=-1).astype(jnp.int32)
+                    cand = lax.dynamic_update_slice(cand, nxt[:, None], (0, i + 1))
+                    return cand, dcache
+
+                cand, draft_cache = lax.fori_loop(
+                    0, k, dstep, (cand0, draft_cache)
+                )
+                cand = cand[:, :k]  # the k-th drafted token is never proposed
+                # --- verify the whole window in one main forward --------
+                vlog, main_cache = forward_with_cache(
+                    cfg, params, cand, main_cache, pos, dtype=self.dtype
+                )
+                targets = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [1,k]
+                # longest matching prefix of drafted vs verifier tokens
+                match = cand[0, 1:] == targets[0, : k - 1]  # [k-1]
+                n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+                adv = n_acc + 1  # accepted drafts + the verifier bonus token
+                # eos inside the accepted span clamps the advance
+                acc_mask = jnp.arange(k) < adv
+                is_eos = (targets[0] == eos_id) & acc_mask
+                has_eos = jnp.any(is_eos)
+                adv = jnp.where(has_eos, jnp.argmax(is_eos) + 1, adv)
+                tokens_buf = lax.dynamic_update_slice(
+                    tokens_buf, targets, (0, pos + 1)
+                )
+                return (
+                    tokens_buf, main_cache, draft_cache, pos + adv,
+                    done | has_eos, rounds + 1,
+                )
+
+            done0 = (n0 == eos_id)[0]
+            tokens_buf, _, _, pos, _, rounds = lax.while_loop(
+                cond,
+                body,
+                (tokens_buf, main_cache, draft_cache,
+                 jnp.asarray(prompt_len), done0, jnp.asarray(0)),
+            )
+            # positions past the last accepted token hold rejected-window
+            # garbage: restore the eos fill the buffer started with
+            fill = jnp.where(eos_id >= 0, eos_id, 0)
+            idx = jnp.arange(total_alloc)[None, :]
+            tokens_buf = jnp.where(idx <= pos, tokens_buf, fill)
+            # rounds = verifier forwards: acceptance observability (a perfect
+            # draft needs ceil((new_tokens-1)/k) rounds)
+            return tokens_buf[:, :total_len], rounds
+
+        return jax.jit(spec_generate)
 
     # ------------------------------------------------------------- generate
     def _build_decode(self, B: int, prompt_len: int, total_len: int):
@@ -315,10 +447,14 @@ class InferenceEngine:
         top_p: float = 1.0,
         repetition_penalty: float = 1.0,
         eos_token_id: int = -1,
+        num_draft_tokens: int = 4,
         rng: Optional[jax.Array] = None,
     ):
         """Greedy (temperature=0) or top-k / top-p sampled decoding, with
-        an optional HF-convention repetition penalty.
+        an optional HF-convention repetition penalty. With a draft model
+        attached (init_inference(draft_model=...)), greedy B=1 generation
+        runs speculatively: ``num_draft_tokens`` proposals per verifier
+        forward, output identical to plain greedy.
 
         Returns [B, prompt + max_new_tokens] token ids (eos-padded).
         """
@@ -336,6 +472,32 @@ class InferenceEngine:
                 f"max_tokens"
             )
         total_len = min(prompt_len + max_new_tokens, self.max_tokens)
+        speculative = (
+            self.draft_model is not None
+            and temperature == 0.0
+            and B == 1
+            and repetition_penalty == 1.0
+            and num_draft_tokens >= 1
+        )
+        if speculative:
+            k = int(num_draft_tokens) + 1  # window = drafts + bonus slot
+            key = ("spec", prompt_len, total_len, k)
+            if key not in self._decode_fns:
+                self._decode_fns[key] = self._build_spec_decode(
+                    prompt_len, total_len, k
+                )
+            buf = np.full(
+                (1, total_len + k),
+                eos_token_id if eos_token_id >= 0 else 0, dtype=np.int32,
+            )
+            buf[:, :prompt_len] = ids
+            with use_topology(self.topology), self._impl_ctx():
+                out, rounds = self._decode_fns[key](
+                    self.params, self.draft_params, jnp.asarray(buf),
+                    eos_token_id,
+                )
+            self.last_spec_rounds = int(rounds)  # verifier calls this generate
+            return np.asarray(out)
         key = (B, prompt_len, total_len)
         if key not in self._decode_fns:
             self._decode_fns[key] = self._build_decode(B, prompt_len, total_len)

@@ -461,3 +461,88 @@ def test_decode_attention_kernel_mixed_storage_dtype():
     logits = jnp.where(kpos <= 64, logits, -1e30)
     ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), vf)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-3)
+
+
+def test_speculative_decode_matches_plain_greedy():
+    """Greedy speculative decoding is exact: with ANY draft model, the
+    output must be token-for-token identical to plain greedy decoding of
+    the main model (acceptance only keeps verifier-approved tokens)."""
+    import deepspeed_tpu
+
+    main = tiny_llama()
+    draft = llama(
+        "llama-tiny", vocab_size=main.config.vocab_size, max_seq_len=64,
+        hidden_size=32, num_layers=1, num_heads=2, num_kv_heads=2,
+        head_dim=16, intermediate_size=64,
+    )
+    plain = deepspeed_tpu.init_inference(main, dtype=jnp.float32,
+                                         max_tokens=64)
+    spec = deepspeed_tpu.init_inference(main, dtype=jnp.float32,
+                                        max_tokens=64, draft_model=draft)
+    prompt = np.random.RandomState(5).randint(0, main.config.vocab_size,
+                                              size=(1, 8))
+    want = plain.generate(prompt, max_new_tokens=20)
+    for k in (1, 3, 6):
+        got = spec.generate(prompt, max_new_tokens=20, num_draft_tokens=k)
+        assert (got == want).all(), (k, got.tolist(), want.tolist())
+
+
+def test_speculative_decode_eos_and_fallback():
+    """eos inside an accepted window stops generation; sampled/batched
+    requests fall back to the normal decode loop."""
+    import deepspeed_tpu
+
+    main = tiny_llama()
+    draft = tiny_llama()
+    spec = deepspeed_tpu.init_inference(main, dtype=jnp.float32,
+                                        max_tokens=64, draft_model=draft)
+    plain = deepspeed_tpu.init_inference(main, dtype=jnp.float32,
+                                         max_tokens=64)
+    prompt = np.random.RandomState(6).randint(0, main.config.vocab_size,
+                                              size=(1, 8))
+    want = plain.generate(prompt, max_new_tokens=16, eos_token_id=3)
+    got = spec.generate(prompt, max_new_tokens=16, eos_token_id=3,
+                        num_draft_tokens=3)
+    assert (got == want).all()
+
+    # batched (B=2) silently takes the plain path and still works
+    p2 = np.random.RandomState(7).randint(0, main.config.vocab_size,
+                                          size=(2, 8))
+    out = spec.generate(p2, max_new_tokens=4)
+    assert out.shape == (2, 12)
+
+    # vocab mismatch is rejected up front
+    import pytest as _pytest
+
+    bad = llama("llama-tiny", vocab_size=main.config.vocab_size * 2,
+                max_seq_len=64, hidden_size=32, num_layers=1, num_heads=2,
+                num_kv_heads=2, head_dim=16, intermediate_size=64)
+    with _pytest.raises(ValueError):
+        deepspeed_tpu.init_inference(main, draft_model=bad)
+
+
+def test_speculative_full_acceptance_round_count():
+    """With draft params == main params, every proposal is accepted: the
+    verifier must run only ceil((new-1)/k) rounds. Catches the draft-cache
+    hole regression (an unwritten row after a fully-accepting round would
+    desync the draft and inflate the round count)."""
+    import math
+
+    import deepspeed_tpu
+
+    main = tiny_llama()
+    params = main.init(jax.random.PRNGKey(0))
+    spec = deepspeed_tpu.init_inference(
+        main, dtype=jnp.float32, max_tokens=64, params=params,
+        draft_model=main, draft_params=params,
+    )
+    prompt = np.random.RandomState(8).randint(0, main.config.vocab_size,
+                                              size=(1, 8))
+    new = 24
+    for nd in (2, 4):
+        k = nd + 1
+        out = spec.generate(prompt, max_new_tokens=new, num_draft_tokens=nd)
+        assert out.shape == (1, 8 + new)
+        assert spec.last_spec_rounds == math.ceil((new - 1) / k), (
+            nd, spec.last_spec_rounds
+        )
