@@ -65,19 +65,36 @@ def main():
         "input_ids": np.random.RandomState(0).randint(0, cfg.vocab_size, size=(B, S))
     }
 
-    # least-recompute policy that fits HBM: "none" keeps device flops ==
-    # model flops (honest MFU); the ladder degrades on OOM instead of dying
+    # least-recompute config that fits HBM: "none" keeps device flops ==
+    # model flops (honest MFU); the ladder degrades on OOM instead of dying.
+    # Measured on the 16GB v5e: smaller micro-batch with zero recompute
+    # beats full batch with attn_mlp recompute, so the ladder prefers
+    # shrinking micro (grad-accum scan) before adding recompute.
     policy = os.environ.get("BENCH_REMAT", "")
-    ladder = [policy] if policy else [
-        "none", "dots_flash", "dots_saveable", "attn_mlp", "full",
-    ]
+    # per-device micro-batch bounds: the batch triangle requires
+    # B == micro * accum * dp, so the largest valid micro is B // dp
+    dp = max(len(jax.devices()), 1)
+    mb_full = max(B // dp, 1)
+    mb_half = max(mb_full // 2, 1)
+    ladder = (
+        [(policy, mb_full)]
+        if policy
+        else [
+            ("none", mb_full), ("dots_flash", mb_full),
+            ("dots_flash", mb_half), ("dots_saveable", mb_half),
+            ("attn_mlp", mb_full), ("full", mb_full),
+            # last resort: heavy remat at reduced micro
+            ("attn_mlp", mb_half), ("full", mb_half),
+        ]
+    )
     engine = None
-    for pol in ladder:
+    for pol, micro in ladder:
         try:
             engine, *_ = deepspeed_tpu.initialize(
                 model=model,
                 config={
                     "train_batch_size": B,
+                    "train_micro_batch_size_per_gpu": micro,
                     "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
                     "bf16": {"enabled": True},
                     "zero_optimization": {"stage": 0},
@@ -87,7 +104,7 @@ def main():
                 },
             )
             engine.train_batch(batch=data)  # compile
-            policy = pol
+            policy = f"{pol}@mb{micro}"
             break
         except Exception as e:
             if "RESOURCE_EXHAUSTED" in str(e) or "Ran out of memory" in str(e):
