@@ -12,6 +12,7 @@ from .version import __version__  # noqa: F401
 from .config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
 from .comm import init_distributed  # noqa: F401
 from . import zero  # noqa: F401  (deepspeed.zero parity surface)
+from . import checkpointing  # noqa: F401  (deepspeed.checkpointing parity)
 
 
 def initialize(*args, **kwargs):

@@ -1,0 +1,123 @@
+"""Activation-checkpointing user API.
+
+Parity: deepspeed.checkpointing (deepspeed/runtime/activation_checkpointing/
+checkpointing.py) — the `configure()` + `checkpoint()` pair Megatron-style
+integrations call directly instead of going through ds_config. TPU-native:
+`checkpoint(fn, *args)` is `jax.checkpoint` under the policy `configure()`
+selected; the reference's partitioned/offloaded activation options map onto
+the same policy names the engine uses (runtime/activation_checkpointing.py),
+with `cpu_checkpointing` = the `offload_host` policy.
+
+The reference's RNG tracker (model-parallel cuda rng states) has no TPU
+counterpart: jax PRNG keys are values threaded through the program, so
+recompute replays identical randomness by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+_config = {"policy": "full"}
+
+
+def configure(
+    mpu=None,
+    deepspeed_config: Optional[Any] = None,
+    partition_activations: Optional[bool] = None,
+    contiguous_checkpointing: Optional[bool] = None,
+    num_checkpoints: Optional[int] = None,
+    checkpoint_in_cpu: Optional[bool] = None,
+    synchronize: Optional[bool] = None,
+    profile: Optional[bool] = None,
+    policy: Optional[str] = None,
+) -> None:
+    """Parity: deepspeed.checkpointing.configure(...).
+
+    Reference knobs that describe GPU memory plumbing (partition /
+    contiguous / synchronize) are accepted and ignored — XLA owns activation
+    placement; `checkpoint_in_cpu=True` selects the `offload_host` policy
+    (saved residuals in pinned host memory), and `policy` picks any of the
+    engine's remat policies directly."""
+    del mpu, partition_activations, contiguous_checkpointing
+    del num_checkpoints, synchronize, profile
+    chosen = None
+    if deepspeed_config is not None:
+        from .config import DeepSpeedConfig
+
+        cfg = (
+            deepspeed_config
+            if isinstance(deepspeed_config, DeepSpeedConfig)
+            else DeepSpeedConfig(deepspeed_config)
+        )
+        section = cfg.activation_checkpointing
+        # an explicit checkpoint() call means "rematerialize": the section's
+        # "none" default must not silently turn the wrapper into identity
+        chosen = section.policy if section.policy != "none" else "full"
+        if section.cpu_checkpointing:
+            chosen = "offload_host"
+    if checkpoint_in_cpu:
+        chosen = "offload_host"
+    if policy is not None:
+        chosen = policy
+    if chosen is None:
+        return
+    _config["policy"] = _validated(chosen)
+
+
+def _validated(name: str) -> str:
+    """Fail (or fall back) at configure() time, not at the distant first
+    checkpoint() call."""
+    from .runtime.activation_checkpointing import policy_by_name
+    from .utils.logging import warning_once
+
+    try:
+        policy_by_name(name)
+    except KeyError:
+        if name == "offload_host":
+            # jax builds without save_and_offload_only_these_names don't
+            # register it (runtime/activation_checkpointing.py)
+            warning_once(
+                "checkpointing: offload_host policy unavailable on this jax "
+                "build; falling back to 'full' rematerialization"
+            )
+            return "full"
+        raise
+    return name
+
+
+def checkpoint(function, *args):
+    """Parity: deepspeed.checkpointing.checkpoint(fn, *args) — run ``fn``
+    under the configured rematerialization policy."""
+    from .runtime.activation_checkpointing import checkpoint_fn
+
+    return checkpoint_fn(function, _config["policy"])(*args)
+
+
+def is_configured() -> bool:
+    return True
+
+
+def get_cuda_rng_tracker():
+    """Parity stub: jax PRNG keys are explicit values — recompute replays
+    the same randomness without a tracker. Returns a no-op context holder."""
+
+    class _Tracker:
+        def add(self, name, seed):
+            pass
+
+        def fork(self):
+            import contextlib
+
+            return contextlib.nullcontext()
+
+    return _Tracker()
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """Parity stub (see get_cuda_rng_tracker)."""
+
+
+def reset() -> None:
+    _config["policy"] = "full"

@@ -148,6 +148,11 @@ class TpuEngine:
         self.config = config
         self.topology = topology
         self.timers = SynchronizedWallClockTimer()
+        from ..utils.timer import ThroughputTimer
+
+        # steady-state samples/sec: async dispatch makes per-call host time
+        # track device time once the queue fills; the first steps are skipped
+        self.tput = ThroughputTimer(batch_size=config.train_batch_size)
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
@@ -872,6 +877,7 @@ class TpuEngine:
         Accepts either a global-batch dict (``batch=``) or an iterator
         yielding them (``data_iter=``).
         """
+        self.tput.start()
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs data_iter or batch")
@@ -971,11 +977,14 @@ class TpuEngine:
             aux = (
                 f" moe_aux={float(metrics['moe_aux_loss']):.4f}" if show_moe else ""
             )
+            sps = self.tput.avg_samples_per_sec
+            tput = f" samples/sec={sps:.1f}" if sps > 0 else ""
             log_dist(
                 f"step {self.global_steps}: loss={float(metrics['loss']):.4f} "
                 f"lr={float(metrics['lr']):.3e} gnorm={float(metrics['grad_norm']):.3f}"
-                f"{aux}"
+                f"{aux}{tput}"
             )
+        self.tput.stop()
         return metrics["loss"]
 
     def _next_batch(self, data_iter):
