@@ -19,7 +19,7 @@ from typing import Any, Optional
 
 import jax
 
-_config = {"policy": "full"}
+_config = {"policy": "full", "configured": False}
 
 
 def configure(
@@ -61,9 +61,9 @@ def configure(
         chosen = "offload_host"
     if policy is not None:
         chosen = policy
-    if chosen is None:
-        return
-    _config["policy"] = _validated(chosen)
+    if chosen is not None:
+        _config["policy"] = _validated(chosen)  # raises before marking configured
+    _config["configured"] = True
 
 
 def _validated(name: str) -> str:
@@ -96,7 +96,8 @@ def checkpoint(function, *args):
 
 
 def is_configured() -> bool:
-    return True
+    """Parity: False until configure() is called (integrations gate on it)."""
+    return _config["configured"]
 
 
 def get_cuda_rng_tracker():
@@ -121,3 +122,4 @@ def model_parallel_cuda_manual_seed(seed: int) -> None:
 
 def reset() -> None:
     _config["policy"] = "full"
+    _config["configured"] = False

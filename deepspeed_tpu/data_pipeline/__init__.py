@@ -1,2 +1,9 @@
 from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_analyzer import (  # noqa: F401
+    CurriculumSampler,
+    DataAnalyzer,
+    analyze_dataset,
+    load_index,
+    write_index,
+)
 from .random_ltd import RandomLTDScheduler, random_ltd_layer  # noqa: F401

@@ -1,0 +1,114 @@
+"""Offline data analysis for curriculum learning.
+
+Parity: deepspeed/runtime/data_pipeline/data_analyzer.py (DataAnalyzer) —
+the offline pass that scores every sample's difficulty and writes an index
+the curriculum sampler consumes. The reference shards the scan over torch
+ranks and writes memory-mapped index files; here the scan is a vectorized
+numpy pass (the dataset fits host memory in this framework's dataloader
+contract) producing one ``.npz`` index.
+
+Metrics (reference names):
+- ``seqlen``: non-pad token count per sample.
+- ``vocabularyrarity``: mean negative log frequency of a sample's tokens —
+  rarer vocabulary = harder sample.
+
+``CurriculumSampler`` orders samples easy→hard to follow the scheduler's
+difficulty pacing: at each step it draws from the easiest fraction whose
+difficulty quantile matches ``current_difficulty / max_difficulty``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+METRICS = ("seqlen", "vocabularyrarity")
+
+
+def analyze_dataset(
+    input_ids: np.ndarray,
+    metrics: Sequence[str] = METRICS,
+    pad_id: int = -1,
+    vocab_size: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Score [N, S] token samples; returns {metric: [N] float64 scores}."""
+    ids = np.asarray(input_ids)
+    if ids.ndim != 2:
+        raise ValueError(f"input_ids must be [N, S], got {ids.shape}")
+    valid = ids != pad_id
+    out: Dict[str, np.ndarray] = {}
+    for m in metrics:
+        if m == "seqlen":
+            out[m] = valid.sum(axis=1).astype(np.float64)
+        elif m == "vocabularyrarity":
+            V = max(vocab_size or int(ids.max()) + 1, 1)
+            flat = np.where(valid, ids, 0).ravel()
+            counts = np.bincount(flat, minlength=V).astype(np.float64)
+            # remove the pad-slot inflation from the masked fill value
+            counts[0] -= (~valid).sum()
+            total = max(counts.sum(), 1.0)
+            freq = np.maximum(counts / total, 1e-12)
+            nll = -np.log(freq)
+            per_tok = np.where(valid, nll[ids.clip(0)], 0.0)
+            out[m] = per_tok.sum(axis=1) / np.maximum(valid.sum(axis=1), 1)
+        else:
+            raise ValueError(f"unknown metric {m!r}; have {METRICS}")
+    return out
+
+
+def write_index(path: str, scores: Dict[str, np.ndarray]) -> str:
+    """Persist the difficulty index (one .npz; reference: index map files)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **scores)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def load_index(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+class DataAnalyzer:
+    """Parity-surface wrapper: analyze → write index → build a sampler."""
+
+    def __init__(self, metrics: Sequence[str] = METRICS, pad_id: int = -1):
+        self.metrics = tuple(metrics)
+        self.pad_id = pad_id
+
+    def run(self, input_ids, save_path: Optional[str] = None):
+        """Returns the scores; with ``save_path``, also writes the index and
+        records the actual file written (np.savez appends .npz) in
+        ``self.index_path``."""
+        scores = analyze_dataset(input_ids, self.metrics, self.pad_id)
+        self.index_path = write_index(save_path, scores) if save_path else None
+        return scores
+
+
+class CurriculumSampler:
+    """Easy→hard sample ordering following the scheduler's pacing.
+
+    At difficulty d (of max D), batches draw uniformly from the easiest
+    ``d / D`` fraction of samples — the reference's difficulty-based data
+    sampling, minus its distributed index plumbing (the dp shard split
+    happens downstream in the dataloader)."""
+
+    def __init__(self, scores: np.ndarray, scheduler, seed: int = 0):
+        order = np.argsort(np.asarray(scores), kind="stable")
+        self.order = order  # easy → hard
+        self.scheduler = scheduler
+        self.rng = np.random.RandomState(seed)
+
+    def sample_indices(self, step: int, batch_size: int) -> np.ndarray:
+        d = self.scheduler.get_difficulty(step)
+        frac = min(max(d / self.scheduler.max_difficulty, 0.0), 1.0)
+        n_avail = max(int(round(frac * len(self.order))), batch_size)
+        n_avail = min(n_avail, len(self.order))
+        # without replacement when the pool allows (reference: shuffled
+        # partition of the eligible samples)
+        if n_avail >= batch_size:
+            pick = self.rng.choice(n_avail, size=batch_size, replace=False)
+        else:
+            pick = self.rng.randint(0, n_avail, size=batch_size)
+        return self.order[pick]

@@ -30,7 +30,7 @@ from ..comm.topology import MeshTopology, ParallelDims
 from ..config import DeepSpeedConfig
 from ..models.sharding import use_topology
 from ..utils.logging import log_dist
-from ..utils.timer import SynchronizedWallClockTimer
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from ..utils.tree import global_norm, tree_cast
 from .dataloader import DeepSpeedDataLoader
 from .lr_schedules import build_schedule
@@ -148,8 +148,6 @@ class TpuEngine:
         self.config = config
         self.topology = topology
         self.timers = SynchronizedWallClockTimer()
-        from ..utils.timer import ThroughputTimer
-
         # steady-state samples/sec: async dispatch makes per-call host time
         # track device time once the queue fills; the first steps are skipped
         self.tput = ThroughputTimer(batch_size=config.train_batch_size)
@@ -970,6 +968,11 @@ class TpuEngine:
             if show_moe:
                 events.append((
                     "Train/moe_aux_loss", float(metrics["moe_aux_loss"]),
+                    self.global_steps,
+                ))
+            if self.tput.avg_samples_per_sec > 0:
+                events.append((
+                    "Train/samples_per_sec", self.tput.avg_samples_per_sec,
                     self.global_steps,
                 ))
             self.monitor.write_events(events)

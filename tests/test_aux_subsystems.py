@@ -402,8 +402,10 @@ def test_checkpointing_user_api():
         # rng tracker stubs exist (Megatron-style call sites)
         with checkpointing.get_cuda_rng_tracker().fork():
             pass
+        assert checkpointing.is_configured()
     finally:
         checkpointing.reset()
+    assert not checkpointing.is_configured()
 
 
 def test_throughput_timer_wired_into_engine(devices8, monkeypatch):
