@@ -55,33 +55,39 @@ def test_chunked_ce_bf16_compute_close():
     np.testing.assert_allclose(float(got), float(ref), rtol=2e-2)
 
 
-def test_engine_trains_with_fused_ce_and_matches_dense_trajectory():
-    """Same seed/data: fused-CE engine loss trajectory ~= dense-CE engine."""
+def _tiny_llama():
     from deepspeed_tpu.models import llama
 
-    def run(fused):
-        model = llama(
-            "llama-tiny", vocab_size=256, max_seq_len=64, hidden_size=64,
-            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
-            intermediate_size=128,
-        )
-        engine, *_ = deepspeed_tpu.initialize(
-            model=model,
-            config={
-                "train_batch_size": 8,
-                "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
-                "zero_optimization": {"stage": 0},
-                "tpu_kernels": {"fused_ce": fused, "ce_chunk": 64},
-            },
-            rng=jax.random.PRNGKey(0),
-        )
-        batch = {
-            "input_ids": np.random.RandomState(0).randint(0, 256, size=(8, 64))
-        }
-        return [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    return llama(
+        "llama-tiny", vocab_size=256, max_seq_len=64, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128,
+    )
 
-    dense = run(False)
-    fused = run(True)
+
+def _run_trajectory(fused, steps=4, config_overrides=None, topology=None):
+    """Loss trajectory of the tiny engine with fused CE on/off; every
+    fused-vs-dense parity test in this file is this plus its overrides."""
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 0},
+        "tpu_kernels": {"fused_ce": fused, "ce_chunk": 64},
+    }
+    for k, v in (config_overrides or {}).items():
+        cfg[k] = v
+    kw = {} if topology is None else {"topology": topology}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=_tiny_llama(), config=cfg, rng=jax.random.PRNGKey(0), **kw
+    )
+    batch = {"input_ids": np.random.RandomState(0).randint(0, 256, size=(8, 64))}
+    return [float(engine.train_batch(batch=batch)) for _ in range(steps)]
+
+
+def test_engine_trains_with_fused_ce_and_matches_dense_trajectory():
+    """Same seed/data: fused-CE engine loss trajectory ~= dense-CE engine."""
+    dense = _run_trajectory(False, steps=5)
+    fused = _run_trajectory(True, steps=5)
     assert fused[-1] < fused[0]
     np.testing.assert_allclose(fused, dense, rtol=1e-3)
 
@@ -130,31 +136,37 @@ def test_fused_ce_with_fp16_loss_scaling():
     """The chunked-CE custom VJP must propagate the scaled-loss cotangent
     exactly like the dense path (fp16 dynamic loss scaling multiplies the
     loss before grad)."""
-    from deepspeed_tpu.models import llama
-
-    def run(fused):
-        model = llama(
-            "llama-tiny", vocab_size=256, max_seq_len=64, hidden_size=64,
-            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
-            intermediate_size=128,
-        )
-        engine, *_ = deepspeed_tpu.initialize(
-            model=model,
-            config={
-                "train_batch_size": 8,
-                "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
-                "fp16": {"enabled": True, "initial_scale_power": 8},
-                "zero_optimization": {"stage": 1},
-                "tpu_kernels": {"fused_ce": fused, "ce_chunk": 64},
-            },
-            rng=jax.random.PRNGKey(0),
-        )
-        batch = {
-            "input_ids": np.random.RandomState(0).randint(0, 256, size=(8, 64))
-        }
-        return [float(engine.train_batch(batch=batch)) for _ in range(4)]
-
-    fused = run(True)
-    dense = run(False)
+    overrides = {
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "zero_optimization": {"stage": 1},
+    }
+    fused = _run_trajectory(True, config_overrides=overrides)
+    dense = _run_trajectory(False, config_overrides=overrides)
     assert np.isfinite(fused).all()
     np.testing.assert_allclose(fused, dense, rtol=2e-3)
+
+
+def test_fused_ce_zero3_matches_dense_on_mesh():
+    """fused CE under ZeRO-3 dp x fsdp sharding (the default-on TPU path)
+    must track the dense-loss engine trajectory on the same mesh."""
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.comm import MeshTopology, ParallelDims
+
+    overrides = {
+        "zero_optimization": {
+            "stage": 3, "stage3_param_persistence_threshold": 1,
+        },
+    }
+
+    def run(fused):
+        comm.destroy_process_group()
+        topo = MeshTopology(ParallelDims(dp=4, fsdp=2), devices=jax.devices())
+        comm.set_topology(topo)
+        out = _run_trajectory(fused, config_overrides=overrides, topology=topo)
+        comm.destroy_process_group()
+        return out
+
+    dense = run(False)
+    fused = run(True)
+    assert fused[-1] < fused[0]
+    np.testing.assert_allclose(fused, dense, rtol=1e-3)
