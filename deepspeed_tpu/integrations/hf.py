@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+import jax
 import numpy as np
 
 from ..models.transformer import TransformerConfig, TransformerModel
@@ -224,6 +225,75 @@ def import_hf_state_dict(
         return params
 
     raise ValueError(f"unsupported family {family!r}")
+
+
+def export_hf_state_dict(
+    params: Dict[str, Any],
+    cfg: TransformerConfig,
+    family: str,
+) -> Dict[str, np.ndarray]:
+    """This package's param pytree → an HF state_dict (numpy host copy).
+
+    The inverse of import_hf_state_dict for round-tripping trained weights
+    back into transformers (reference users do this via zero_to_fp32 →
+    load_state_dict). Supported: "llama"/"mistral" (RMSNorm family) and
+    "gpt2" (fused-qkv Conv1D family)."""
+    p = jax.tree.map(_np, params)
+    L = cfg.num_layers
+    out: Dict[str, np.ndarray] = {}
+
+    if family in ("llama", "mistral"):
+        out["model.embed_tokens.weight"] = p["embed"]["tok"]
+        out["model.norm.weight"] = p["final_norm"]["scale"]
+        if not cfg.tie_embeddings and "lm_head" in p:
+            out["lm_head.weight"] = p["lm_head"].T
+        at, ml = p["layers"]["attn"], p["layers"]["mlp"]
+        for i in range(L):
+            pre = f"model.layers.{i}."
+            out[pre + "input_layernorm.weight"] = p["layers"]["ln1"]["scale"][i]
+            out[pre + "post_attention_layernorm.weight"] = (
+                p["layers"]["ln2"]["scale"][i]
+            )
+            out[pre + "self_attn.q_proj.weight"] = at["wq"][i].T
+            out[pre + "self_attn.k_proj.weight"] = at["wk"][i].T
+            out[pre + "self_attn.v_proj.weight"] = at["wv"][i].T
+            out[pre + "self_attn.o_proj.weight"] = at["wo"][i].T
+            out[pre + "mlp.gate_proj.weight"] = ml["wg"][i].T
+            out[pre + "mlp.up_proj.weight"] = ml["wi"][i].T
+            out[pre + "mlp.down_proj.weight"] = ml["wo"][i].T
+        return out
+
+    if family == "gpt2":
+        # GPT2LMHeadModel nests the decoder under .transformer (lm_head is
+        # tied to wte, so no separate head tensor)
+        out["transformer.wte.weight"] = p["embed"]["tok"]
+        out["transformer.wpe.weight"] = p["embed"]["pos"]
+        out["transformer.ln_f.weight"] = p["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = p["final_norm"]["bias"]
+        at, ml = p["layers"]["attn"], p["layers"]["mlp"]
+        for i in range(L):
+            pre = f"transformer.h.{i}."
+            out[pre + "ln_1.weight"] = p["layers"]["ln1"]["scale"][i]
+            out[pre + "ln_1.bias"] = p["layers"]["ln1"]["bias"][i]
+            out[pre + "ln_2.weight"] = p["layers"]["ln2"]["scale"][i]
+            out[pre + "ln_2.bias"] = p["layers"]["ln2"]["bias"][i]
+            out[pre + "attn.c_attn.weight"] = np.concatenate(
+                [at["wq"][i], at["wk"][i], at["wv"][i]], axis=1
+            )
+            out[pre + "attn.c_attn.bias"] = np.concatenate(
+                [at["bq"][i], at["bk"][i], at["bv"][i]]
+            )
+            out[pre + "attn.c_proj.weight"] = at["wo"][i]
+            out[pre + "attn.c_proj.bias"] = at["bo"][i]
+            out[pre + "mlp.c_fc.weight"] = ml["wi"][i]
+            out[pre + "mlp.c_fc.bias"] = ml["bi"][i]
+            out[pre + "mlp.c_proj.weight"] = ml["wo"][i]
+            out[pre + "mlp.c_proj.bias"] = ml["bo"][i]
+        return out
+
+    raise ValueError(
+        f"export unsupported for family {family!r} (have llama/mistral/gpt2)"
+    )
 
 
 def config_from_hf(hf_config) -> TransformerConfig:
