@@ -236,8 +236,9 @@ def export_hf_state_dict(
 
     The inverse of import_hf_state_dict for round-tripping trained weights
     back into transformers (reference users do this via zero_to_fp32 →
-    load_state_dict). Supported: "llama"/"mistral" (RMSNorm family) and
-    "gpt2" (fused-qkv Conv1D family)."""
+    load_state_dict). Supported: "llama"/"mistral" (RMSNorm family), "gpt2"
+    and "bloom" (fused-qkv families); keys carry the causal-LM wrapper
+    prefix (model. / transformer.) so load_state_dict works directly."""
     p = jax.tree.map(_np, params)
     L = cfg.num_layers
     out: Dict[str, np.ndarray] = {}
@@ -291,8 +292,55 @@ def export_hf_state_dict(
             out[pre + "mlp.c_proj.bias"] = ml["bo"][i]
         return out
 
+    if family == "bloom":
+        # BloomForCausalLM nests the decoder under .transformer (lm_head is
+        # tied to the word embeddings)
+        nh, hd, d = cfg.num_heads, cfg.hd, cfg.hidden_size
+        out["transformer.word_embeddings.weight"] = p["embed"]["tok"]
+        out["transformer.word_embeddings_layernorm.weight"] = (
+            p["embed_norm"]["scale"]
+        )
+        out["transformer.word_embeddings_layernorm.bias"] = (
+            p["embed_norm"]["bias"]
+        )
+        out["transformer.ln_f.weight"] = p["final_norm"]["scale"]
+        out["transformer.ln_f.bias"] = p["final_norm"]["bias"]
+        at, ml = p["layers"]["attn"], p["layers"]["mlp"]
+        for i in range(L):
+            pre = f"transformer.h.{i}."
+            out[pre + "input_layernorm.weight"] = p["layers"]["ln1"]["scale"][i]
+            out[pre + "input_layernorm.bias"] = p["layers"]["ln1"]["bias"][i]
+            out[pre + "post_attention_layernorm.weight"] = (
+                p["layers"]["ln2"]["scale"][i]
+            )
+            out[pre + "post_attention_layernorm.bias"] = (
+                p["layers"]["ln2"]["bias"][i]
+            )
+            # re-interleave q/k/v into bloom's fused [H, 3, hd, d] layout
+            w3 = np.stack(
+                [at[k][i].T.reshape(nh, hd, d) for k in ("wq", "wk", "wv")],
+                axis=1,
+            )  # [H, 3, hd, d]
+            b3 = np.stack(
+                [at[k][i].reshape(nh, hd) for k in ("bq", "bk", "bv")], axis=1
+            )  # [H, 3, hd]
+            out[pre + "self_attention.query_key_value.weight"] = w3.reshape(
+                3 * nh * hd, d
+            )
+            out[pre + "self_attention.query_key_value.bias"] = b3.reshape(
+                3 * nh * hd
+            )
+            out[pre + "self_attention.dense.weight"] = at["wo"][i].T
+            out[pre + "self_attention.dense.bias"] = at["bo"][i]
+            out[pre + "mlp.dense_h_to_4h.weight"] = ml["wi"][i].T
+            out[pre + "mlp.dense_h_to_4h.bias"] = ml["bi"][i]
+            out[pre + "mlp.dense_4h_to_h.weight"] = ml["wo"][i].T
+            out[pre + "mlp.dense_4h_to_h.bias"] = ml["bo"][i]
+        return out
+
     raise ValueError(
-        f"export unsupported for family {family!r} (have llama/mistral/gpt2)"
+        f"export unsupported for family {family!r} "
+        f"(have llama/mistral/gpt2/bloom)"
     )
 
 
