@@ -227,6 +227,26 @@ def import_hf_state_dict(
     raise ValueError(f"unsupported family {family!r}")
 
 
+def _export_llama_trunk(out, p, cfg, L):
+    """Shared llama/mistral/mixtral export: embeddings, norms, attention
+    projections, lm_head — everything except the MLP/MoE block."""
+    out["model.embed_tokens.weight"] = p["embed"]["tok"]
+    out["model.norm.weight"] = p["final_norm"]["scale"]
+    if not cfg.tie_embeddings and "lm_head" in p:
+        out["lm_head.weight"] = p["lm_head"].T
+    at = p["layers"]["attn"]
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        out[pre + "input_layernorm.weight"] = p["layers"]["ln1"]["scale"][i]
+        out[pre + "post_attention_layernorm.weight"] = (
+            p["layers"]["ln2"]["scale"][i]
+        )
+        out[pre + "self_attn.q_proj.weight"] = at["wq"][i].T
+        out[pre + "self_attn.k_proj.weight"] = at["wk"][i].T
+        out[pre + "self_attn.v_proj.weight"] = at["wv"][i].T
+        out[pre + "self_attn.o_proj.weight"] = at["wo"][i].T
+
+
 def export_hf_state_dict(
     params: Dict[str, Any],
     cfg: TransformerConfig,
@@ -236,29 +256,18 @@ def export_hf_state_dict(
 
     The inverse of import_hf_state_dict for round-tripping trained weights
     back into transformers (reference users do this via zero_to_fp32 →
-    load_state_dict). Supported: "llama"/"mistral" (RMSNorm family), "gpt2"
-    and "bloom" (fused-qkv families); keys carry the causal-LM wrapper
+    load_state_dict). Supported: "llama"/"mistral", "gpt2", "bloom",
+    "mixtral" — every import family; keys carry the causal-LM wrapper
     prefix (model. / transformer.) so load_state_dict works directly."""
     p = jax.tree.map(_np, params)
     L = cfg.num_layers
     out: Dict[str, np.ndarray] = {}
 
     if family in ("llama", "mistral"):
-        out["model.embed_tokens.weight"] = p["embed"]["tok"]
-        out["model.norm.weight"] = p["final_norm"]["scale"]
-        if not cfg.tie_embeddings and "lm_head" in p:
-            out["lm_head.weight"] = p["lm_head"].T
-        at, ml = p["layers"]["attn"], p["layers"]["mlp"]
+        _export_llama_trunk(out, p, cfg, L)
+        ml = p["layers"]["mlp"]
         for i in range(L):
             pre = f"model.layers.{i}."
-            out[pre + "input_layernorm.weight"] = p["layers"]["ln1"]["scale"][i]
-            out[pre + "post_attention_layernorm.weight"] = (
-                p["layers"]["ln2"]["scale"][i]
-            )
-            out[pre + "self_attn.q_proj.weight"] = at["wq"][i].T
-            out[pre + "self_attn.k_proj.weight"] = at["wk"][i].T
-            out[pre + "self_attn.v_proj.weight"] = at["wv"][i].T
-            out[pre + "self_attn.o_proj.weight"] = at["wo"][i].T
             out[pre + "mlp.gate_proj.weight"] = ml["wg"][i].T
             out[pre + "mlp.up_proj.weight"] = ml["wi"][i].T
             out[pre + "mlp.down_proj.weight"] = ml["wo"][i].T
@@ -338,9 +347,25 @@ def export_hf_state_dict(
             out[pre + "mlp.dense_4h_to_h.bias"] = ml["bo"][i]
         return out
 
+    if family == "mixtral":
+        E = cfg.num_experts
+        _export_llama_trunk(out, p, cfg, L)
+        ml = p["layers"]["mlp"]
+        # mixtral expert naming: w1 = gate, w3 = up, w2 = down
+        expert_keys = (("w1", "wg"), ("w3", "wi"), ("w2", "wo"))
+        for i in range(L):
+            pre = f"model.layers.{i}."
+            out[pre + "block_sparse_moe.gate.weight"] = ml["router"][i].T
+            for hf_name, ours in expert_keys:
+                for e in range(E):
+                    out[
+                        pre + f"block_sparse_moe.experts.{e}.{hf_name}.weight"
+                    ] = ml[ours][i, e].T
+        return out
+
     raise ValueError(
         f"export unsupported for family {family!r} "
-        f"(have llama/mistral/gpt2/bloom)"
+        f"(have llama/mistral/gpt2/bloom/mixtral)"
     )
 
 

@@ -457,3 +457,44 @@ def test_hf_export_roundtrip_bloom():
         np.testing.assert_allclose(
             hf2(ids).logits.numpy(), hf(ids).logits.numpy(), atol=1e-5
         )
+
+
+def test_hf_export_roundtrip_mixtral():
+    """mixtral: per-expert w1/w2/w3 unstack + router, loads into a fresh
+    MixtralForCausalLM with identical logits."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    from deepspeed_tpu.integrations.hf import (
+        config_from_hf,
+        export_hf_state_dict,
+        import_hf_state_dict,
+    )
+
+    torch.manual_seed(5)
+    hf = MixtralForCausalLM(MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=32,
+    )).eval()
+    cfg = config_from_hf(hf.config)
+    params = import_hf_state_dict(hf.state_dict(), cfg, family="mixtral")
+    exported = export_hf_state_dict(params, cfg, family="mixtral")
+    params2 = import_hf_state_dict(exported, cfg, family="mixtral")
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(params2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    hf2 = MixtralForCausalLM(hf.config).eval()
+    missing, unexpected = hf2.load_state_dict(
+        {k: torch.from_numpy(np.array(v)) for k, v in exported.items()},
+        strict=False,
+    )
+    assert not unexpected, unexpected
+    ids = torch.from_numpy(np.random.RandomState(5).randint(0, 128, size=(1, 8)))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(ids).logits.numpy(), hf(ids).logits.numpy(), atol=1e-5
+        )
