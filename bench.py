@@ -33,23 +33,28 @@ def peak_flops_per_chip() -> float:
     return peaks.get(gen, 197e12)
 
 
-def main():
+def enable_compile_cache():
+    """Warm restarts reuse compiled programs (best-effort; harmless when the
+    backend compiles remotely). Shared with tools/sweep_train.py."""
     import jax
 
     try:
-        # warm restarts of the driver reuse compiled programs (best-effort;
-        # harmless when the backend compiles remotely)
         jax.config.update("jax_compilation_cache_dir", "/tmp/dstpu_jaxcache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     except Exception:
         pass
-    import deepspeed_tpu
+
+
+def bench_model_and_data(smoke: bool):
+    """The benchmark model: ONE definition shared by bench.py and the
+    operator sweep (tools/sweep_train.py) so "best sweep config" always
+    refers to the model the bench reports.
+
+    head_dim=128 matches the MXU lane width (hd=64 runs the attention
+    matmuls at half MXU utilization: measured 1.6x slower end-to-end)."""
     from deepspeed_tpu.models import llama
 
-    smoke = bool(os.environ.get("BENCH_SMOKE"))  # CPU end-to-end validation
     B, S = (4, 256) if smoke else (8, 2048)
-    # head_dim=128 matches the MXU lane width (hd=64 runs the attention
-    # matmuls at half MXU utilization: measured 1.6x slower end-to-end)
     model = llama(
         "llama-tiny",
         vocab_size=1024 if smoke else 32768,
@@ -61,10 +66,23 @@ def main():
         head_dim=16 if smoke else 128,
         intermediate_size=512 if smoke else 4096,
     )
-    cfg = model.config
     data = {
-        "input_ids": np.random.RandomState(0).randint(0, cfg.vocab_size, size=(B, S))
+        "input_ids": np.random.RandomState(0).randint(
+            0, model.config.vocab_size, size=(B, S)
+        )
     }
+    return model, data, B, S
+
+
+def main():
+    import jax
+
+    enable_compile_cache()
+    import deepspeed_tpu
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))  # CPU end-to-end validation
+    model, data, B, S = bench_model_and_data(smoke)
+    cfg = model.config
 
     # least-recompute config that fits HBM: "none" keeps device flops ==
     # model flops (honest MFU); the ladder degrades on OOM instead of dying.

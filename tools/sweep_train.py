@@ -1,0 +1,102 @@
+"""Training-config sweep on the real chip: micro-batch x remat x flash tiles.
+
+The autotuner (autotuning/autotuner.py) is the in-framework search; this
+companion is the operator's quick grid for the bench model — one JSON line
+per configuration, robust to OOM and pool noise, chained-dispatch timing
+(see bench.py for why per-step readbacks lie on a relayed backend).
+
+Usage:    python tools/sweep_train.py            # default grid
+          python tools/sweep_train.py --quick    # 3 configs
+CPU smoke: BENCH_SMOKE=1 (tiny model, interpret kernels).
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(model, B, data, micro, policy, blocks):
+    import deepspeed_tpu
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_batch_size": B,
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 0},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 100000,
+            "activation_checkpointing": {"policy": policy},
+            "tpu_kernels": {
+                "flash_block_q": blocks[0], "flash_block_k": blocks[1],
+            },
+        },
+    )
+    try:
+        engine.train_batch(batch=data)  # compile
+        float(engine.state.step)
+        trials = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                engine.train_batch(batch=data)
+            float(engine.state.step)
+            trials.append((time.perf_counter() - t0) / 5)
+        return float(np.median(trials))
+    finally:
+        engine.destroy()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import bench_model_and_data, enable_compile_cache
+
+    enable_compile_cache()
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    model, data, B, S = bench_model_and_data(smoke)
+    # batch triangle: B == micro * accum * dp, so micro tops out at B // dp
+    dp = max(len(jax.devices()), 1)
+    mb_full = max(B // dp, 1)
+    micros = [mb_full, max(mb_full // 2, 1)]
+    policies = ["none", "dots_flash", "dots_saveable"]
+    tiles = [(0, 0), (512, 512)]
+    grid = list(itertools.product(micros, policies, tiles))
+    if args.quick or smoke:
+        grid = grid[:3]
+
+    best = None
+    for micro, policy, blocks in grid:
+        try:
+            dt = measure(model, B, data, micro, policy, blocks)
+            rec = {
+                "micro": micro, "policy": policy, "blocks": list(blocks),
+                "step_s": round(dt, 4), "tok_s": round(B * S / dt, 1),
+            }
+            if best is None or rec["tok_s"] > best["tok_s"]:
+                best = rec
+        except Exception as e:  # noqa: BLE001 — a sweep survives bad rungs
+            first = (str(e).splitlines() or [repr(e)])[0]
+            rec = {
+                "micro": micro, "policy": policy, "blocks": list(blocks),
+                "error": first[:160],
+            }
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"best": best}))
+
+
+if __name__ == "__main__":
+    main()
