@@ -44,14 +44,14 @@ def analyze_dataset(
             out[m] = valid.sum(axis=1).astype(np.float64)
         elif m == "vocabularyrarity":
             V = max(vocab_size or int(ids.max()) + 1, 1)
-            flat = np.where(valid, ids, 0).ravel()
-            counts = np.bincount(flat, minlength=V).astype(np.float64)
-            # remove the pad-slot inflation from the masked fill value
-            counts[0] -= (~valid).sum()
+            # masked positions go to a dedicated sentinel slot V (one past
+            # the vocab) so real token 0 never shares a count with padding
+            flat = np.where(valid, ids.clip(0, V - 1), V).ravel()
+            counts = np.bincount(flat, minlength=V + 1)[:V].astype(np.float64)
             total = max(counts.sum(), 1.0)
             freq = np.maximum(counts / total, 1e-12)
             nll = -np.log(freq)
-            per_tok = np.where(valid, nll[ids.clip(0)], 0.0)
+            per_tok = np.where(valid, nll[ids.clip(0, V - 1)], 0.0)
             out[m] = per_tok.sum(axis=1) / np.maximum(valid.sum(axis=1), 1)
         else:
             raise ValueError(f"unknown metric {m!r}; have {METRICS}")

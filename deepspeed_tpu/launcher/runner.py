@@ -124,6 +124,12 @@ def wait_and_propagate(procs: List["subprocess.Popen"], poll_s: float = 1.0) -> 
     def _forward(signum, frame):
         signaled.append(signum)
 
+    def _rc(c: int) -> int:
+        """Map a Popen returncode to a launcher exit code, preserving the
+        shell's 128+signal convention for signal deaths (Popen reports
+        those as -signum) instead of folding them into regular codes."""
+        return 128 - c if c < 0 else c
+
     def _shutdown(rc: int) -> int:
         """terminate → 10s grace → kill, so a rank that traps/ignores
         SIGTERM can't wedge the launcher."""
@@ -137,6 +143,7 @@ def wait_and_propagate(procs: List["subprocess.Popen"], poll_s: float = 1.0) -> 
                 p.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
                 p.kill()
+                p.wait()  # reap promptly post-SIGKILL; no zombies
         return rc
 
     old = (signal.signal(signal.SIGINT, _forward),
@@ -147,10 +154,10 @@ def wait_and_propagate(procs: List["subprocess.Popen"], poll_s: float = 1.0) -> 
                 return _shutdown(128 + signaled[0])
             codes = [p.poll() for p in procs]
             if all(c is not None for c in codes):
-                return max(abs(c) for c in codes) if any(codes) else 0
+                return max(_rc(c) for c in codes) if any(codes) else 0
             failed = [c for c in codes if c not in (None, 0)]
             if failed:
-                return _shutdown(abs(failed[0]))
+                return _shutdown(_rc(failed[0]))
             time.sleep(poll_s)
     finally:
         signal.signal(signal.SIGINT, old[0])
