@@ -66,12 +66,25 @@ def init_distributed(
     if dist_backend not in ("xla", "tpu", "auto"):
         logger.warning(f"dist_backend={dist_backend!r} ignored; TPU build always uses XLA")
     coord = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
-    if coord and jax.process_count() == 1 and not _INITIALIZED:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=num_processes or int(os.environ.get("DSTPU_NUM_PROCESSES", "1")),
-            process_id=process_id or int(os.environ.get("DSTPU_PROCESS_ID", "0")),
-        )
+    nproc = num_processes or int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+    if coord and nproc > 1 and not _INITIALIZED:
+        # NB: must run before anything touches a jax backend (even
+        # jax.process_count() locks it in) — so gate on the distributed
+        # client's own state, and let genuine failures (coordinator
+        # unreachable, backend already locked) raise loudly rather than
+        # silently degrading the job to single-process.
+        from jax._src import distributed as _jax_distributed
+
+        if getattr(_jax_distributed.global_state, "client", None) is None:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=process_id
+                if process_id is not None
+                else int(os.environ.get("DSTPU_PROCESS_ID", "0")),
+            )
+        else:
+            logger.warning("jax.distributed already initialized; reusing it")
     if topology is not None:
         _TOPOLOGY = topology
     elif dims is not None or _TOPOLOGY is None:

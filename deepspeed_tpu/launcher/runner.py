@@ -99,6 +99,14 @@ def build_launch_env(
     return env
 
 
+def spawn_local(env: Dict[str, str], argv: List[str]) -> "subprocess.Popen":
+    """The ``local`` launcher backend: one rank as a direct subprocess on
+    this host (reference: the runner's no-ssh localhost path / launch.py
+    spawning ranks directly). Used for same-box multi-process runs and for
+    exercising the full jax.distributed path without an ssh daemon."""
+    return subprocess.Popen(argv, env=env)
+
+
 def build_ssh_command(host: str, env: Dict[str, str], argv: List[str]) -> List[str]:
     """The per-host remote command (reference: pdsh/OpenMPI runner)."""
     exports = " ".join(
@@ -175,6 +183,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--num_nodes", type=int, default=-1)
     parser.add_argument("--master_addr", default=None)
     parser.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    parser.add_argument("--launcher", default="ssh", choices=["ssh", "local"],
+                        help="per-host backend: ssh (remote hosts, default) "
+                        "or local (each hostfile entry spawns a rank on THIS "
+                        "host; same-box multi-process)")
     parser.add_argument("--dry_run", action="store_true",
                         help="print the launch plan without executing")
     parser.add_argument("script", help="training script")
@@ -195,11 +207,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     hosts = list(resources)
     if args.num_nodes > 0:
         hosts = hosts[: args.num_nodes]
-    coordinator = args.master_addr or hosts[0]
+    coordinator = args.master_addr or (
+        "127.0.0.1" if args.launcher == "local" else hosts[0]
+    )
 
     procs = []
     for pid, host in enumerate(hosts):
         env = build_launch_env(coordinator, args.master_port, len(hosts), pid)
+        if args.launcher == "local":
+            if args.dry_run:
+                print(f"[{host} rank {pid} local] {shlex.join(prog)}")
+                continue
+            procs.append(spawn_local(env, prog))
+            continue
         cmd = build_ssh_command(host, env, prog)
         if args.dry_run:
             print(f"[{host} rank {pid}] {shlex.join(cmd)}")

@@ -1,0 +1,128 @@
+"""Real 2-process ``jax.distributed`` integration through the launcher.
+
+Parity: the reference's launcher (deepspeed/launcher/runner.py) is validated
+by actual multi-rank jobs; its unit suite spawns real ranks for
+torch.distributed paths. Here the ``local`` launcher backend spawns two OS
+processes on this host, each with 2 virtual CPU devices, joined into one
+4-device ``jax.distributed`` job (Gloo CPU collectives). This exercises for
+real what single-process tests cannot:
+
+- ``comm.init_distributed`` -> ``jax.distributed.initialize`` from the
+  DSTPU_* env the launcher exports,
+- cross-process sharded train steps (global arrays, non-addressable shards),
+- ``checkpointing._barrier`` / ``_is_writer`` / per-process shard writes and
+  the global sharded load,
+- ``wait_and_propagate`` failure propagation and signal exit codes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN_WORKER = r'''
+import os, sys
+
+# Fresh interpreter: claim 2 local CPU devices BEFORE any backend init.
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+         if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(flags)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np
+import deepspeed_tpu
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.comm import ParallelDims
+
+ckpt_dir = sys.argv[1]
+
+# reads DSTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID exported by the launcher
+topo = comm.init_distributed(dims=ParallelDims(dp=4))
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+pid = jax.process_index()
+
+from deepspeed_tpu.models import llama
+model = llama("llama-tiny", vocab_size=128, max_seq_len=32, hidden_size=32,
+              num_layers=1, num_heads=2, num_kv_heads=2, intermediate_size=96)
+engine, _, _, _ = deepspeed_tpu.initialize(model=model, topology=topo, config={
+    "train_batch_size": 4,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 1},
+})
+batch = {"input_ids": np.random.RandomState(0).randint(0, 128, size=(4, 16))}
+l0 = float(engine.train_batch(batch=batch))
+engine.save_checkpoint(ckpt_dir)          # per-process shard writes + barrier
+l1 = float(engine.train_batch(batch=batch))  # advance past the saved state
+engine.load_checkpoint(ckpt_dir)          # barrier + global sharded load
+l1b = float(engine.train_batch(batch=batch))
+assert abs(l1 - l1b) < 1e-5, (l1, l1b)    # bit-stable resume across processes
+assert os.path.exists(os.path.join(ckpt_dir, "latest"))
+print(f"WORKER {pid} OK l0={l0:.4f} resume_delta={abs(l1-l1b):.2e}", flush=True)
+'''
+
+FAIL_WORKER = r'''
+import os, sys, time
+pid = int(os.environ["DSTPU_PROCESS_ID"])
+mode = sys.argv[1]
+if pid == 1:
+    if mode == "exit3":
+        sys.exit(3)
+    os.kill(os.getpid(), 9)  # mode == "sigkill"
+time.sleep(120)  # rank 0 wedges; the launcher must tear it down
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(tmp_path, script_body, script_args, timeout=420):
+    script = tmp_path / "worker.py"
+    script.write_text(script_body)
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("rank0 slots=2\nrank1 slots=2\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO  # no relay plugin site dir in the workers
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", str(hostfile), "--launcher", "local",
+         "--master_port", str(_free_port()), str(script), *script_args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+    return proc, time.monotonic() - t0
+
+
+def test_two_process_train_and_sharded_checkpoint(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    proc, _ = _launch(tmp_path, TRAIN_WORKER, [str(ckpt)])
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-3000:]
+    # the sharded layout really is per-process rectangles: with ZeRO-3 over
+    # dp=4 and 2 procs x 2 devices, params carry shards from both processes
+    tag = (ckpt / "latest").read_text().strip()
+    shards = [f for f in os.listdir(ckpt / tag / "params") if ".shard." in f]
+    assert shards, os.listdir(ckpt / tag / "params")
+    # metadata written once, by the writer process only
+    assert (ckpt / tag / "metadata.json").exists()
+
+
+def test_rank_failure_propagates_exit_code(tmp_path):
+    proc, dt = _launch(tmp_path, FAIL_WORKER, ["exit3"], timeout=90)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-1000:])
+    assert dt < 60, f"launcher took {dt:.0f}s to tear down the healthy rank"
+
+
+def test_rank_signal_death_maps_to_128_plus_sig(tmp_path):
+    proc, dt = _launch(tmp_path, FAIL_WORKER, ["sigkill"], timeout=90)
+    assert proc.returncode == 128 + 9, (proc.returncode, proc.stderr[-1000:])
+    assert dt < 60, f"launcher took {dt:.0f}s to tear down the healthy rank"
