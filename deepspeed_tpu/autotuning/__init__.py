@@ -1,1 +1,1 @@
-from .autotuner import Autotuner, autotune  # noqa: F401
+from .autotuner import Autotuner, autotune, result_to_config_patch  # noqa: F401

@@ -47,6 +47,8 @@ class Autotuner:
         self.start_step = int(at.get("start_profile_step", 3))
         self.end_step = int(at.get("end_profile_step", 5))
         self.max_micro = int(at.get("max_train_micro_batch_size_per_gpu", 64))
+        self.trials = int(at.get("trials", 3))  # medians beat noisy pools
+        self.fixed_global_batch = bool(at.get("fixed_global_batch", False))
         self.results: List[Dict[str, Any]] = []
 
     def _candidates(self) -> List[Tuple[int, str]]:
@@ -59,14 +61,38 @@ class Autotuner:
 
     def _measure(self, micro_batch: int, remat: str,
                  blocks: Tuple[int, int] = (0, 0)) -> Optional[float]:
+        """One candidate: fresh engine → compile+warmup → chained-dispatch
+        timing → tokens/sec. This is THE compile+measure loop — the operator
+        sweep (tools/sweep_train.py) is a CLI over it, so the two tuners
+        cannot drift.
+
+        Timing: the chip may sit behind a network relay where every host
+        readback pays the tunnel RTT, so each trial dispatches a chain of
+        steps with ONE blocking read at the end, and trials are reduced by
+        median (shared pools are noisy)."""
         import deepspeed_tpu
 
         cfg = dict(self.base_config)
         cfg.pop("autotuning", None)
-        dp = self.topology.data_shard_size if self.topology else 1
-        accum = int(cfg.get("gradient_accumulation_steps", 1))
+        if self.topology is not None:
+            dp = self.topology.data_shard_size
+        else:
+            # initialize() will build a pure-dp topology over every visible
+            # device; the batch triangle must be computed against that same
+            # dp or every candidate fails config validation
+            import jax
+
+            dp = max(len(jax.devices()), 1)
         cfg["train_micro_batch_size_per_gpu"] = micro_batch
-        cfg["train_batch_size"] = micro_batch * dp * accum
+        if self.fixed_global_batch:
+            # hold the global batch constant and let accumulation absorb
+            # the micro change (operator-sweep semantics: every point sees
+            # identical data and optimizer dynamics)
+            tbs = int(cfg["train_batch_size"])
+            cfg["gradient_accumulation_steps"] = max(tbs // (micro_batch * dp), 1)
+        else:
+            accum = int(cfg.get("gradient_accumulation_steps", 1))
+            cfg["train_batch_size"] = micro_batch * dp * accum
         cfg["activation_checkpointing"] = {"policy": remat}
         if blocks != (0, 0):
             tk = dict(cfg.get("tpu_kernels") or {})
@@ -81,13 +107,16 @@ class Autotuner:
             batch = self.sample_batch_fn(cfg["train_batch_size"])
             for _ in range(self.start_step):  # compile + warmup
                 engine.train_batch(batch=dict(batch))
-            float(engine.state.step)
-            t0 = time.perf_counter()
-            n = max(self.end_step - self.start_step, 1)
-            for _ in range(n):
-                engine.train_batch(batch=dict(batch))
-            float(engine.state.step)
-            dt = (time.perf_counter() - t0) / n
+            float(engine.state.step)  # settle before the timed region
+            chain = max(self.end_step - self.start_step, 1)
+            trials = []
+            for _ in range(self.trials):
+                t0 = time.perf_counter()
+                for _ in range(chain):
+                    engine.train_batch(batch=dict(batch))
+                float(engine.state.step)  # one readback per chain
+                trials.append((time.perf_counter() - t0) / chain)
+            dt = float(np.median(trials))
             tokens = np.asarray(batch["input_ids"]).size
             return tokens / dt
         except Exception as e:  # noqa: BLE001 — OOM pruning is the point
@@ -98,6 +127,27 @@ class Autotuner:
         finally:
             if engine is not None:
                 engine.destroy()  # release logger hooks even on failure
+
+    def measure_grid(self, grid) -> List[Dict[str, Any]]:
+        """Measure an explicit [(micro, remat_policy, (bq, bk)), ...] grid
+        through the same engine as :meth:`tune`. Returns one record per
+        point ({micro_batch, remat_policy, flash_block_*, throughput} or
+        {... , error}); OOM points record throughput None. Non-OOM failures
+        are recorded, not raised — an operator grid survives bad rungs."""
+        records = []
+        for micro, pol, blocks in grid:
+            rec: Dict[str, Any] = {
+                "micro_batch": int(micro), "remat_policy": pol,
+                "flash_block_q": int(blocks[0]), "flash_block_k": int(blocks[1]),
+            }
+            try:
+                rec["throughput"] = self._measure(micro, pol, tuple(blocks))
+            except Exception as e:  # noqa: BLE001
+                rec["error"] = (str(e).splitlines() or [repr(e)])[0][:160]
+            records.append(rec)
+            if rec.get("throughput") is not None:
+                self.results.append(rec)
+        return records
 
     def _flash_tunable(self) -> bool:
         """Phase 2 only makes sense when the flash tile knobs are live."""
@@ -156,6 +206,21 @@ class Autotuner:
                 if tput > best["throughput"]:
                     best = rec
         return best
+
+
+def result_to_config_patch(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """A tuner record → ds_config fragment, mergeable into any base config
+    (the round-trip contract: sweep/tune output feeds straight back into
+    `deepspeed_tpu.initialize(config=...)`)."""
+    patch: Dict[str, Any] = {
+        "train_micro_batch_size_per_gpu": int(rec["micro_batch"]),
+        "activation_checkpointing": {"policy": rec["remat_policy"]},
+    }
+    bq, bk = rec.get("flash_block_q", 0), rec.get("flash_block_k", 0)
+    if bq or bk:
+        patch["tpu_kernels"] = {"flash_block_q": int(bq),
+                                "flash_block_k": int(bk)}
+    return patch
 
 
 def autotune(model, base_config, topology=None, sample_batch_fn=None):

@@ -94,6 +94,7 @@ def test_autotuner_picks_best():
                 "max_train_micro_batch_size_per_gpu": 2,
                 "start_profile_step": 1,
                 "end_profile_step": 2,
+                "trials": 1,  # CPU test: no pool noise to median away
             },
         },
         topology=topo,
@@ -104,3 +105,49 @@ def test_autotuner_picks_best():
     assert best["remat_policy"] in ("none", "attn_mlp", "full")
     assert best["throughput"] > 0
     assert len(tuner.results) >= 2
+
+
+def test_measure_grid_and_config_patch_roundtrip(tmp_path):
+    """The operator sweep's contract: measure_grid records feed
+    result_to_config_patch, and the patch merges straight back into a
+    working ds_config (VERDICT r3 #7: one tuner engine, schema round-trip)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import Autotuner, result_to_config_patch
+
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    topo = MeshTopology(dims=ParallelDims(dp=8))
+    r = np.random.RandomState(0)
+
+    def sample_batch(global_batch):
+        assert global_batch == 16  # fixed_global_batch holds B constant
+        return {"input_ids": r.randint(0, 64, size=(16, 16))}
+
+    tuner = Autotuner(
+        model,
+        {
+            "train_batch_size": 16,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "autotuning": {"start_profile_step": 1, "end_profile_step": 2,
+                           "trials": 1, "fixed_global_batch": True},
+        },
+        topology=topo,
+        sample_batch_fn=sample_batch,
+    )
+    recs = tuner.measure_grid([(2, "none", (0, 0)), (1, "full", (0, 0))])
+    assert [r_["micro_batch"] for r_ in recs] == [2, 1]
+    assert all(r_.get("throughput", 0) > 0 for r_ in recs), recs
+    # bad rung is recorded, not raised
+    bad = tuner.measure_grid([(2, "no_such_policy", (0, 0))])
+    assert "error" in bad[0]
+
+    patch = result_to_config_patch(recs[0])
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    }
+    cfg.update(patch)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, topology=topo,
+                                               config=cfg)
+    loss = float(engine.train_batch(batch=sample_batch(16)))
+    assert np.isfinite(loss)

@@ -34,12 +34,14 @@ def main():
         ap.error("--new-tokens must be > 4 (4 tokens are folded into the "
                  "prefill-timing run; the decode rate would be degenerate)")
 
+    from bench import smoke_mode
+
+    smoke = smoke_mode()  # before any backend init
+
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
-
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
     model = llama(
         "llama-tiny",
         vocab_size=1024 if smoke else 32768,

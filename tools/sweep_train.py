@@ -1,12 +1,17 @@
 """Training-config sweep on the real chip: micro-batch x remat x flash tiles.
 
-The autotuner (autotuning/autotuner.py) is the in-framework search; this
-companion is the operator's quick grid for the bench model — one JSON line
-per configuration, robust to OOM and pool noise, chained-dispatch timing
-(see bench.py for why per-step readbacks lie on a relayed backend).
+A thin CLI over the in-framework Autotuner (autotuning/autotuner.py) — ONE
+compile+measure engine for both tuners, so they cannot drift. The grid runs
+on the bench model (bench.py's definition), prints one JSON line per point,
+and writes the winner to SWEEP_BEST.json at the repo root in TWO shapes:
+the raw record, and a ds_config `config_patch` that merges straight into
+`deepspeed_tpu.initialize(config=...)`. bench.py seeds its OOM ladder from
+this file, so a committed sweep means the bench never burns a known-doomed
+compile again.
 
 Usage:    python tools/sweep_train.py            # default grid
           python tools/sweep_train.py --quick    # 3 configs
+          python tools/sweep_train.py --no-write # don't update SWEEP_BEST
 CPU smoke: BENCH_SMOKE=1 (tiny model, interpret kernels).
 """
 
@@ -15,58 +20,29 @@ import itertools
 import json
 import os
 import sys
-import time
 
-import numpy as np
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_DIR)
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def measure(model, B, data, micro, policy, blocks):
-    import deepspeed_tpu
-
-    engine, *_ = deepspeed_tpu.initialize(
-        model=model,
-        config={
-            "train_batch_size": B,
-            "train_micro_batch_size_per_gpu": micro,
-            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 0},
-            "gradient_clipping": 1.0,
-            "steps_per_print": 100000,
-            "activation_checkpointing": {"policy": policy},
-            "tpu_kernels": {
-                "flash_block_q": blocks[0], "flash_block_k": blocks[1],
-            },
-        },
-    )
-    try:
-        engine.train_batch(batch=data)  # compile
-        float(engine.state.step)
-        trials = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(5):
-                engine.train_batch(batch=data)
-            float(engine.state.step)
-            trials.append((time.perf_counter() - t0) / 5)
-        return float(np.median(trials))
-    finally:
-        engine.destroy()
+SWEEP_BEST = os.path.join(REPO_DIR, "SWEEP_BEST.json")
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-write", action="store_true",
+                    help="don't update SWEEP_BEST.json")
     args = ap.parse_args()
 
     import jax
 
-    from bench import bench_model_and_data, enable_compile_cache
+    from bench import bench_model_and_data, enable_compile_cache, smoke_mode
+    from deepspeed_tpu.autotuning.autotuner import (
+        Autotuner, result_to_config_patch,
+    )
 
+    smoke = smoke_mode()
     enable_compile_cache()
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
     model, data, B, S = bench_model_and_data(smoke)
     # batch triangle: B == micro * accum * dp, so micro tops out at B // dp
     dp = max(len(jax.devices()), 1)
@@ -78,24 +54,42 @@ def main():
     if args.quick or smoke:
         grid = grid[:3]
 
+    def sample_batch(train_batch_size):
+        # grid micros divide B: accum = B // (micro * dp) keeps the global
+        # batch (and the data dict) identical across every point
+        assert train_batch_size == B, (train_batch_size, B)
+        return dict(data)
+
+    tuner = Autotuner(
+        model,
+        base_config={
+            "train_batch_size": B,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 0},
+            "gradient_clipping": 1.0,
+            "autotuning": {"start_profile_step": 1, "end_profile_step": 6,
+                           "fixed_global_batch": True},
+        },
+        sample_batch_fn=sample_batch,
+    )
+
     best = None
-    for micro, policy, blocks in grid:
-        try:
-            dt = measure(model, B, data, micro, policy, blocks)
-            rec = {
-                "micro": micro, "policy": policy, "blocks": list(blocks),
-                "step_s": round(dt, 4), "tok_s": round(B * S / dt, 1),
-            }
+    for rec in tuner.measure_grid(grid):
+        if rec.get("throughput"):
+            rec = dict(rec, step_s=round(B * S / rec["throughput"], 4),
+                       tok_s=round(rec["throughput"], 1))
             if best is None or rec["tok_s"] > best["tok_s"]:
                 best = rec
-        except Exception as e:  # noqa: BLE001 — a sweep survives bad rungs
-            first = (str(e).splitlines() or [repr(e)])[0]
-            rec = {
-                "micro": micro, "policy": policy, "blocks": list(blocks),
-                "error": first[:160],
-            }
         print(json.dumps(rec), flush=True)
-    print(json.dumps({"best": best}))
+
+    out = {"best": best}
+    if best is not None:
+        out["config_patch"] = result_to_config_patch(best)
+    print(json.dumps(out))
+    if best is not None and not args.no_write and not smoke:
+        with open(SWEEP_BEST, "w") as f:
+            json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":
