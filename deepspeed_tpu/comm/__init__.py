@@ -94,6 +94,15 @@ def init_distributed(
     return _TOPOLOGY
 
 
+def barrier(name: str = "barrier") -> None:
+    """Cross-process barrier (parity: deepspeed.comm.barrier). No-op in a
+    single-process job; multi-host it rides sync_global_devices."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def set_topology(topology: MeshTopology) -> None:
     global _TOPOLOGY, _INITIALIZED
     _TOPOLOGY = topology

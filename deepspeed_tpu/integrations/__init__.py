@@ -1,1 +1,2 @@
 from .hf import HfEngineAdapter, import_hf_model, import_hf_state_dict  # noqa: F401
+from .trainer import TrainerStrategyAdapter  # noqa: F401

@@ -82,10 +82,9 @@ def _is_writer() -> bool:
 
 
 def _barrier(name: str) -> None:
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    from .. import comm
 
-        multihost_utils.sync_global_devices(name)
+    comm.barrier(name)
 
 
 def _bounds_token(index, shape) -> str:
