@@ -71,6 +71,12 @@ def get_attention_impl() -> str:
     return _CURRENT
 
 
+def resolve_attention_impl():
+    """The impl that would run right now: a registered name, or the scoped
+    callable override. ("auto" resolves: flash on TPU, xla elsewhere.)"""
+    return _resolve()
+
+
 def xla_attention(q, k, v, *, causal=True, bias=None, segment_ids=None,
                   alibi_slopes=None):
     """Reference attention. q: [B,S,H,hd], k/v: [B,S,KV,hd] (GQA aware).

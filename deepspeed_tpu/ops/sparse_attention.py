@@ -3,12 +3,12 @@
 Parity: csrc/sparse_attention/ + deepspeed/ops/sparse_attention/ (SparseSelfAttention,
 sparsity_config.py). The reference builds triton/CUDA block-sparse matmuls
 from a layout tensor; here the same block layout feeds the Pallas flash
-kernel's predication path (ops/pallas/flash_attention.py `block_mask`): a
-masked-off tile skips its QK^T/AV MXU work inside the one fused
-online-softmax kernel — no separate sdd/dsd/dds matmul trio needed, XLA/
-Mosaic already fuse the rest. (Tiles are still DMA'd; skipping the fetch too
-is a future double-buffering optimization — compute, not bandwidth, is what
-the sparse patterns save at these block sizes.)
+kernel's compacted grid (ops/pallas/flash_attention.py `block_mask`): the
+layout becomes scalar-prefetch compaction tables, the kernel grid walks
+only each row's active blocks, and masked tiles are neither computed NOR
+fetched from HBM — both the MXU work and the DMA bandwidth scale with the
+layout's density, like the reference's triton lut-driven sdd/dsd kernels.
+No separate sdd/dsd/dds matmul trio needed; XLA/Mosaic fuse the rest.
 
 Patterns mirror the reference's sparsity_config classes: Fixed (local +
 periodic global), BigBird (window + global + random), BSLongformer (sliding

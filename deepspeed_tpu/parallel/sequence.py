@@ -223,7 +223,33 @@ def ring_attention(q, k, v, *, causal=True, segment_ids=None,
         else jnp.zeros((q.shape[2],), jnp.float32)
     )
 
+    # flash-ring when the flash kernel is the active impl and the local
+    # chunk tiles; else the dense online-softmax ring (same math, O(S_loc²)
+    # logits per hop instead of O(block²) kernel tiles)
+    from ..ops.attention import resolve_attention_impl
+    from ..ops.pallas.ring_flash import ring_blocks, ring_flash_attention_local
+
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    S_loc = S // topo.sp_size
+    blocks = ring_blocks(S_loc)
+    use_flash = (
+        resolve_attention_impl() == "flash"
+        and blocks is not None
+        and H % KV == 0
+        and hd % 8 == 0
+    )
+
     def body(ql, kl, vl, segl, sl):
+        if use_flash:
+            return ring_flash_attention_local(
+                ql, kl, vl,
+                segl if has_seg else None,
+                segl if has_seg else None,
+                sl if has_alibi else None,
+                causal=causal, axis=axis,
+                block_q=blocks[0], block_k=blocks[1],
+            )
         return _ring_attention_local(
             ql, kl, vl, segl, segl if has_seg else None,
             sl if has_alibi else None, causal=causal, axis=axis,

@@ -153,3 +153,67 @@ def test_sparse_attention_config_validation():
                          "sparse_attention": {"mode": "fixed"},
                          "data_efficiency": {"data_routing": {"random_ltd": {
                              "enabled": True}}}})
+
+
+def test_compaction_tables_pad_repeat_and_counts():
+    """The DMA-skip tables: active columns ascending, padding repeats the
+    last index (consecutive equal indices → Mosaic skips the re-fetch)."""
+    import numpy as np
+
+    from deepspeed_tpu.ops.pallas.flash_attention import _compact_rows
+
+    layout = np.array([
+        [1, 0, 1, 0],
+        [0, 0, 0, 0],
+        [1, 1, 1, 1],
+        [0, 1, 0, 0],
+    ])
+    idx, counts = _compact_rows(layout)
+    assert counts.tolist() == [2, 0, 4, 1]
+    assert idx.shape == (4, 4)  # jmax = densest row
+    assert idx[0].tolist() == [0, 2, 2, 2]  # pad repeats last active
+    assert idx[1].tolist() == [0, 0, 0, 0]  # empty row: predicated off
+    assert idx[2].tolist() == [0, 1, 2, 3]
+    assert idx[3].tolist() == [1, 1, 1, 1]
+
+
+def test_sparse_grid_is_compacted_not_dense():
+    """The kernel grid's last dim is jmax (densest row), not nk — the
+    structural evidence that masked tiles are skipped, not just predicated."""
+    import numpy as np
+
+    from deepspeed_tpu.ops.pallas.flash_attention import _compact_rows
+
+    cfg = BSLongformerSparsityConfig(block=128, num_sliding_window_blocks=3)
+    S = 128 * 16
+    layout = causal_trim(cfg.make_layout(S))
+    kcols, _ = _compact_rows(layout)
+    nk = S // 128
+    assert kcols.shape[1] < nk, (kcols.shape, nk)  # strictly fewer steps
+    # and the window+global pattern bounds the row density independent of S
+    assert kcols.shape[1] <= 2 + 1 + 1  # window(2 causal) + global col + row
+
+
+def test_traced_block_mask_falls_back_with_reason():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas import flash_attention as fa_mod
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    fa_mod._logged_fallbacks.clear()
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (1, 256, 2, 64))
+    # non-trivial layout: dropping it would NOT reproduce dense attention
+    layout = np.array([[1, 0], [0, 1]], np.int32)
+
+    @jax.jit
+    def run(q, mask):
+        return flash_attention(q, q, q, causal=True, block_mask=mask,
+                               block_q=128, block_k=128)
+
+    out = run(q, jnp.asarray(layout))  # mask is a tracer inside jit
+    ref = dense_blocksparse_reference(q, q, q, layout, 128, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    reasons = [r for key in fa_mod._logged_fallbacks for r in key]
+    assert any("trace-time static" in r for r in reasons), reasons
