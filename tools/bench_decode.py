@@ -29,6 +29,9 @@ def main():
                     choices=["auto", "bf16", "int8"],
                     help="KV cache storage (int8: quantized, half HBM)")
     ap.add_argument("--new-tokens", type=int, default=128)
+    ap.add_argument("--speculative", action="store_true",
+                    help="attach a 2-layer draft of the same family "
+                    "(greedy speculative decoding; token-exact output)")
     args = ap.parse_args()
     if args.new_tokens <= 4 and not os.environ.get("BENCH_SMOKE"):
         ap.error("--new-tokens must be > 4 (4 tokens are folded into the "
@@ -53,6 +56,19 @@ def main():
         head_dim=16 if smoke else 128,
         intermediate_size=512 if smoke else 4096,
     )
+    draft = None
+    if args.speculative:
+        draft = llama(
+            "llama-tiny",
+            vocab_size=1024 if smoke else 32768,
+            max_seq_len=256 if smoke else 2048,
+            hidden_size=128 if smoke else 512,
+            num_layers=2,
+            num_heads=8,
+            num_kv_heads=4,
+            head_dim=16 if smoke else 64,
+            intermediate_size=512 if smoke else 2048,
+        )
     engine = deepspeed_tpu.init_inference(
         model,
         tp_size=1,
@@ -60,6 +76,7 @@ def main():
         replace_with_kernel_inject=not args.no_inject,
         kv_cache_dtype=args.kv_cache,
         max_tokens=256 if smoke else 2048,
+        draft_model=draft,
     )
     B, prompt_len = 1, 16 if smoke else 128
     new = 16 if smoke else args.new_tokens
@@ -96,6 +113,8 @@ def main():
                 "dtype": args.dtype,
                 "kv_cache": args.kv_cache,
                 "kernel_inject": not args.no_inject,
+                "speculative": args.speculative,
+                "spec_rounds": getattr(engine, "last_spec_rounds", None),
                 "smoke": smoke,
             }
         )

@@ -1,0 +1,167 @@
+"""One-command on-chip perf campaign (VERDICT r3 next-round #1 and #3).
+
+The TPU pool behind the relay goes down for hours at a time; when it
+answers, every measurement the round needs must be captured before it can
+drop again. This orchestrator runs the full battery in dependency order,
+each stage in a fresh subprocess under its own timeout (a hung relay call
+can't wedge the campaign), streaming everything into ``perf/``:
+
+  1. probe     — tiny op + readback (exit 2 if the pool is down)
+  2. sweep     — tools/sweep_train.py full grid → SWEEP_BEST.json + jsonl
+  3. bench     — bench.py (ladder seeded by the fresh sweep) → json
+  4. decode    — tools/bench_decode.py grid over dtype x kv x inject x spec
+  5. profile   — engine.profile_step() xprof trace at the sweep-best config
+
+Usage:  python tools/tpu_campaign.py [--quick] [--skip probe,sweep,...]
+Artifacts land in perf/ — commit them.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF = os.path.join(REPO, "perf")
+PY = sys.executable
+
+PROBE_SRC = """
+import jax, jax.numpy as jnp
+print("PROBE_OK", float(jnp.sum(jnp.ones((8, 8)))), jax.devices())
+"""
+
+PROFILE_SRC = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from bench import bench_model_and_data, enable_compile_cache, load_sweep_seed
+import jax
+enable_compile_cache()
+import deepspeed_tpu
+
+model, data, B, S = bench_model_and_data(False)
+dp = max(len(jax.devices()), 1)
+seed = load_sweep_seed(dp, B) or ("dots_saveable", max(B // dp // 2, 1), {{}})
+pol, micro, tk = seed
+engine, *_ = deepspeed_tpu.initialize(model=model, config={{
+    "train_batch_size": B,
+    "train_micro_batch_size_per_gpu": micro,
+    "optimizer": {{"type": "adamw", "params": {{"lr": 1e-4}}}},
+    "bf16": {{"enabled": True}},
+    "zero_optimization": {{"stage": 0}},
+    "gradient_clipping": 1.0,
+    "steps_per_print": 10**9,
+    "activation_checkpointing": {{"policy": pol}},
+    "tpu_kernels": tk,
+}})
+engine.train_batch(batch=data)  # compile outside the trace
+engine.train_batch(batch=data)  # warm
+loss, trace_dir = engine.profile_step(batch=data, trace_dir={trace!r})
+print("PROFILE_OK", float(loss), trace_dir)
+"""
+
+
+def run_stage(name, cmd, log, timeout, env=None):
+    """One stage = one subprocess; output tees to the stage log."""
+    t0 = time.time()
+    print(f"[campaign] {name}: {' '.join(cmd)}", flush=True)
+    with open(log, "w") as lf:
+        try:
+            proc = subprocess.run(
+                cmd, stdout=lf, stderr=subprocess.STDOUT, cwd=REPO,
+                timeout=timeout, env=env or os.environ.copy(),
+            )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+    dt = time.time() - t0
+    tail = ""
+    try:
+        with open(log) as lf:
+            tail = lf.read()[-400:]
+    except OSError:
+        pass
+    print(f"[campaign] {name}: rc={rc} ({dt:.0f}s)\n{tail}", flush=True)
+    return {"stage": name, "rc": rc, "seconds": round(dt, 1), "log": log}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="sweep --quick and a reduced decode grid")
+    ap.add_argument("--skip", default="",
+                    help="comma-separated stages to skip")
+    args = ap.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+    os.makedirs(PERF, exist_ok=True)
+    results = []
+
+    def save_manifest():
+        with open(os.path.join(PERF, "campaign.json"), "w") as f:
+            json.dump(results, f, indent=1)
+
+    # 1. probe — subprocess so a relay hang costs 120s, not the campaign
+    if "probe" not in skip:
+        r = run_stage("probe", [PY, "-c", PROBE_SRC],
+                      os.path.join(PERF, "probe.log"), timeout=120)
+        results.append(r)
+        save_manifest()
+        if r["rc"] != 0:
+            print("[campaign] pool is DOWN; aborting (exit 2)", flush=True)
+            return 2
+
+    # 2. sweep — refreshes SWEEP_BEST.json, which seeds stage 3's ladder
+    if "sweep" not in skip:
+        cmd = [PY, "tools/sweep_train.py"] + (["--quick"] if args.quick else [])
+        results.append(run_stage("sweep", cmd,
+                                 os.path.join(PERF, "sweep.jsonl"),
+                                 timeout=5400))
+        save_manifest()
+
+    # 3. bench — the driver-facing record
+    if "bench" not in skip:
+        results.append(run_stage("bench", [PY, "bench.py"],
+                                 os.path.join(PERF, "bench.json"),
+                                 timeout=3600))
+        save_manifest()
+
+    # 4. decode grid (reference headline: DeepSpeed-Inference serving)
+    if "decode" not in skip:
+        grid = [
+            [],                                      # bf16 baseline
+            ["--no-inject"],                         # inject must beat this
+            ["--kv-cache", "int8"],
+            ["--dtype", "int8"],
+            ["--dtype", "int4"],
+            ["--speculative"],
+        ]
+        if args.quick:
+            grid = grid[:3]
+        for i, extra in enumerate(grid):
+            tag = "_".join(extra).replace("--", "") or "bf16"
+            results.append(run_stage(
+                f"decode[{tag}]",
+                [PY, "tools/bench_decode.py", *extra],
+                os.path.join(PERF, f"decode_{i}_{tag}.json"),
+                timeout=2400,
+            ))
+            save_manifest()
+
+    # 5. xprof at the sweep-best config — the step-gap localizer
+    if "profile" not in skip:
+        trace = os.path.join(PERF, "xprof_trace")
+        src = PROFILE_SRC.format(repo=REPO, trace=trace)
+        results.append(run_stage("profile", [PY, "-c", src],
+                                 os.path.join(PERF, "profile.log"),
+                                 timeout=3600))
+        save_manifest()
+
+    bad = [r for r in results if r["rc"] != 0]
+    print(f"[campaign] done: {len(results) - len(bad)}/{len(results)} stages "
+          f"ok; artifacts in {PERF}", flush=True)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
