@@ -933,6 +933,13 @@ def set_default_block_sizes(block_q: int = 0, block_k: int = 0) -> None:
 _block_scope_stack: list = []
 _logged_fallbacks: set = set()
 
+# Causal runs ride the block-sparse compaction path by default (skips the
+# above-diagonal k/v DMA — ~2x less HBM traffic on the attention stream).
+# DSTPU_FLASH_CAUSAL_SKIP=0 restores the dense grid (A/B kill-switch).
+import os as _os  # noqa: E402
+
+_CAUSAL_DMA_SKIP = _os.environ.get("DSTPU_FLASH_CAUSAL_SKIP", "1") != "0"
+
 
 def current_block_sizes() -> tuple:
     """The (block_q, block_k) preference in effect right now: innermost
@@ -1105,6 +1112,22 @@ def flash_attention(
                 f"block_mask shape {layout_np.shape} != (nq={S // bq}, "
                 f"nk={S // bk}) for seq {S} with blocks ({bq}, {bk})"
             )
+    elif causal and bias is None and _CAUSAL_DMA_SKIP:
+        # (bias excluded: its dbias paths use the dense grid)
+        # Plain causal attention IS a static block-sparse layout (lower
+        # block-triangle): without tables, above-diagonal tiles are
+        # predicated off but still DMA'd — nearly half the k/v HBM stream
+        # fetched and discarded. Synthesize the triangle and ride the same
+        # compaction path (grid length is still nk — the densest row —
+        # but padded steps repeat an index, so Mosaic skips their fetch).
+        import numpy as _np
+
+        qi_idx = _np.arange(S // bq)[:, None]
+        ki_idx = _np.arange(S // bk)[None, :]
+        # _block_visible works on numpy arrays: one source of truth with
+        # the in-kernel predicate
+        layout_np = _block_visible(qi_idx, ki_idx, bq, bk).astype(_np.int32)
+    if layout_np is not None:
         # compaction tables (see _compact_rows): the kernels walk only the
         # active blocks, so masked tiles are never fetched from HBM
         kcols, kcounts = _compact_rows(layout_np)

@@ -488,3 +488,25 @@ def test_unaligned_seq_fallback_names_reason():
         flash_attention(q, q, q, causal=True)
     hits = [m for m in cap.messages() if "falling back" in m]
     assert len(hits) == 1 and "128-aligned" in hits[0]
+
+
+def test_causal_dma_skip_bitmatches_dense_grid(monkeypatch):
+    """Causal runs ride the compaction (DMA-skip) path by default; the
+    k-blocks process in the same ascending order as the dense grid, so the
+    two paths are bit-identical — and the kill-switch restores the dense
+    grid."""
+    from deepspeed_tpu.ops.pallas import flash_attention as fa_mod
+
+    assert fa_mod._CAUSAL_DMA_SKIP  # default on
+    q, k, v = _qkv(jax.random.PRNGKey(21), B=1, S=256, H=2, D=64)
+    out_skip = fa_mod.flash_attention(q, k, v, causal=True,
+                                      block_q=128, block_k=128)
+    g_skip = jax.grad(lambda a: jnp.sum(fa_mod.flash_attention(
+        a, k, v, causal=True, block_q=128, block_k=128) ** 2))(q)
+    monkeypatch.setattr(fa_mod, "_CAUSAL_DMA_SKIP", False)
+    out_dense = fa_mod.flash_attention(q, k, v, causal=True,
+                                       block_q=128, block_k=128)
+    g_dense = jax.grad(lambda a: jnp.sum(fa_mod.flash_attention(
+        a, k, v, causal=True, block_q=128, block_k=128) ** 2))(q)
+    np.testing.assert_array_equal(np.asarray(out_skip), np.asarray(out_dense))
+    np.testing.assert_array_equal(np.asarray(g_skip), np.asarray(g_dense))
