@@ -56,6 +56,7 @@ class TransformerConfig:
     # MoE (Mixtral): >0 experts turns the MLP into a routed expert layer.
     num_experts: int = 0
     moe_top_k: int = 2
+    moe_dispatch: str = "einsum"  # einsum (one-hot dots) | gather (indexed)
     moe_capacity_factor: float = 2.0
     moe_aux_loss_coef: float = 0.01
     moe_z_loss_coef: float = 1e-3

@@ -9,6 +9,15 @@ same all-to-all the reference hand-codes, but fused and overlapped.
 
 Top-1/top-k gating with capacity factor, token dropping, load-balance aux
 loss and router z-loss match the reference's TopKGate semantics.
+
+Two dispatch formulations share one gating loop (``moe_dispatch``):
+- "einsum" (default): one-hot dispatch/combine dots — GShard-style, rides
+  the MXU, sharding-friendly.
+- "gather": index tables drive plain gathers — the one-hot dots are
+  permutations written as dense matmuls (O(N·E·C·D) flops to move O(N·D)
+  values; at 16k tokens / 8 experts / cap 2 that is ~1 TFLOP of pure data
+  movement per layer per direction), so the gather form trades MXU flops
+  for HBM bytes. A/B on-chip via the model config; parity-tested.
 """
 
 from __future__ import annotations
@@ -20,6 +29,44 @@ import jax
 import jax.numpy as jnp
 
 from ..models.sharding import constrain
+
+
+def _gating_rounds(logits, top_k, capacity, rng, train, noise_std):
+    """The shared top-k selection loop: per-round (expert idx, slot pos,
+    keep mask, raw gate value) plus the aux metrics. ONE implementation so
+    the einsum and gather dispatch paths cannot diverge."""
+    N, E = logits.shape
+    if train and noise_std > 0.0 and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+    gates = jax.nn.softmax(logits, axis=-1)  # [N, E]
+
+    fill = jnp.zeros((E,), jnp.int32)
+    masked_gates = gates
+    me = jnp.mean(gates, axis=0)  # gate fraction per expert
+    ce_acc = jnp.zeros((E,), jnp.float32)
+    rounds = []
+    kept_total = jnp.zeros((), jnp.float32)
+
+    for _ in range(top_k):
+        idx = jnp.argmax(masked_gates, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [N, E]
+        # position of each token within its chosen expert (this round)
+        pos_in_round = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        pos = pos_in_round + fill[None, :] * onehot
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
+        keep = pos_tok < capacity
+        gate_val = jnp.sum(gates * onehot, axis=-1)  # [N]
+        rounds.append((idx, pos_tok, keep, gate_val))
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+        ce_acc = ce_acc + jnp.mean(onehot, axis=0)
+        kept_total = kept_total + jnp.sum(keep.astype(jnp.float32))
+        masked_gates = masked_gates * (1.0 - onehot)  # exclude chosen expert
+
+    aux_loss = E * jnp.sum(me * (ce_acc / top_k))
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    dropped = 1.0 - kept_total / (N * top_k)
+    metrics = {"aux_loss": aux_loss, "z_loss": z_loss, "drop_fraction": dropped}
+    return rounds, metrics
 
 
 def top_k_gating(
@@ -37,44 +84,69 @@ def top_k_gating(
     tokens dropped, load-balance loss = E * mean(gate_frac * token_frac).
     """
     N, E = logits.shape
-    if train and noise_std > 0.0 and rng is not None:
-        logits = logits + jax.random.normal(rng, logits.shape) * noise_std
-    gates = jax.nn.softmax(logits, axis=-1)  # [N, E]
-
+    rounds, metrics = _gating_rounds(logits, top_k, capacity, rng, train,
+                                     noise_std)
     combine = jnp.zeros((N, E, capacity), jnp.float32)
     dispatch = jnp.zeros((N, E, capacity), jnp.bool_)
-    # running per-expert fill count is carried across the k selection rounds
-    fill = jnp.zeros((E,), jnp.int32)
-    masked_gates = gates
-    me = jnp.mean(gates, axis=0)  # gate fraction per expert
-    ce_acc = jnp.zeros((E,), jnp.float32)
-
-    for _ in range(top_k):
-        idx = jnp.argmax(masked_gates, axis=-1)  # [N]
-        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [N, E]
-        # position of each token within its chosen expert (this round)
-        pos_in_round = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
-        pos = pos_in_round + fill[None, :] * onehot
-        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
-        keep = pos_tok < capacity
-        gate_val = jnp.sum(gates * onehot, axis=-1)  # [N]
-        pos_oh = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity), capacity + 1)[:, :capacity]
+    for idx, pos_tok, keep, gate_val in rounds:
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        pos_oh = jax.nn.one_hot(
+            jnp.where(keep, pos_tok, capacity), capacity + 1
+        )[:, :capacity]
         contrib = onehot[:, :, None] * pos_oh[:, None, :]  # [N, E, C]
         combine = combine + contrib * gate_val[:, None, None] * keep[:, None, None]
         dispatch = dispatch | (contrib > 0) & keep[:, None, None]
-        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
-        ce_acc = ce_acc + jnp.mean(onehot, axis=0)
-        masked_gates = masked_gates * (1.0 - onehot)  # exclude chosen expert next round
 
     # renormalize combine weights over selected experts (top-2 reference behavior)
     denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
     combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), combine)
-
-    aux_loss = E * jnp.sum(me * (ce_acc / top_k))
-    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
-    dropped = 1.0 - jnp.sum(dispatch.astype(jnp.float32)) / (N * top_k)
-    metrics = {"aux_loss": aux_loss, "z_loss": z_loss, "drop_fraction": dropped}
     return dispatch.astype(jnp.float32), combine, metrics
+
+
+def top_k_gating_indices(
+    logits: jax.Array,  # [N, E] fp32
+    top_k: int,
+    capacity: int,
+    rng: Optional[jax.Array],
+    train: bool,
+    noise_std: float = 0.0,
+):
+    """Index-table form of :func:`top_k_gating` (same selection loop).
+
+    Returns (tok_of_slot [E,C] int32, slot_valid [E,C] bool,
+    slot_of_tok [N,K] int32 flat e*C+c, w_of_tok [N,K] fp32, metrics).
+    The one-hot dispatch/combine einsums are permutations written as dense
+    dots — O(N·E·C·D) MXU flops to move O(N·D) values; these tables drive
+    plain gathers instead (O(N·D·K) bytes), the sort-based formulation TPU
+    MoE stacks use (and the reference's all-to-all ordering implies)."""
+    N, E = logits.shape
+    rounds, metrics = _gating_rounds(logits, top_k, capacity, rng, train,
+                                     noise_std)
+    # one extra dummy slot soaks up dropped tokens' scatter writes
+    tok_flat = jnp.zeros((E * capacity + 1,), jnp.int32)
+    valid_flat = jnp.zeros((E * capacity + 1,), jnp.bool_)
+    slot_of_tok = []
+    w_raw = []
+    arange_n = jnp.arange(N, dtype=jnp.int32)
+    for idx, pos_tok, keep, gate_val in rounds:
+        flat = idx * capacity + jnp.minimum(pos_tok, capacity - 1)
+        target = jnp.where(keep, flat, E * capacity)
+        tok_flat = tok_flat.at[target].set(arange_n)
+        valid_flat = valid_flat.at[target].set(True)
+        slot_of_tok.append(jnp.where(keep, flat, 0))
+        w_raw.append(gate_val * keep)
+    # (the dummy slot E*capacity is sliced off below — its contents never
+    # reach the gather path)
+    w = jnp.stack(w_raw, axis=1)  # [N, K]
+    denom = jnp.sum(w, axis=1, keepdims=True)
+    w = jnp.where(denom > 0, w / jnp.maximum(denom, 1e-9), w)
+    return (
+        tok_flat[:-1].reshape(E, capacity),
+        valid_flat[:-1].reshape(E, capacity),
+        jnp.stack(slot_of_tok, axis=1),
+        w,
+        metrics,
+    )
 
 
 def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool):
@@ -93,12 +165,31 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
     router_logits = jnp.einsum(
         "nd,de->ne", tokens.astype(jnp.float32), p["router"].astype(jnp.float32)
     )
-    dispatch, combine, metrics = top_k_gating(
-        router_logits, cfg.moe_top_k, capacity, rng, train
-    )
-
-    # dispatch: [N,E,C] x [N,D] -> [E,C,D], sharded over ep
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
+    dispatch_mode = getattr(cfg, "moe_dispatch", "einsum")
+    if dispatch_mode not in ("einsum", "gather"):
+        # an A/B sweep typo must not silently benchmark the wrong path
+        raise ValueError(
+            f"moe_dispatch {dispatch_mode!r} (must be 'einsum' or 'gather')"
+        )
+    use_gather = dispatch_mode == "gather"
+    if use_gather:
+        # permutation as gathers, not one-hot dots: O(N·D·K) moved bytes
+        # instead of O(N·E·C·D) MXU flops each way
+        tok_of_slot, slot_valid, slot_of_tok, w_of_tok, metrics = (
+            top_k_gating_indices(router_logits, cfg.moe_top_k, capacity, rng,
+                                 train)
+        )
+        expert_in = (
+            jnp.take(tokens, tok_of_slot.reshape(-1), axis=0)
+            .reshape(E, capacity, D)
+            * slot_valid[..., None].astype(x.dtype)
+        )
+    else:
+        dispatch, combine, metrics = top_k_gating(
+            router_logits, cfg.moe_top_k, capacity, rng, train
+        )
+        # dispatch: [N,E,C] x [N,D] -> [E,C,D], sharded over ep
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
     expert_in = constrain(expert_in, "ep", None, None)
 
     h = jnp.einsum("ecd,edf->ecf", expert_in, p["wi"])
@@ -111,7 +202,14 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
     expert_out = jnp.einsum("ecf,efd->ecd", h, p["wo"])
     expert_out = constrain(expert_out, "ep", None, None)
 
-    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    if use_gather:
+        picked = jnp.take(
+            expert_out.reshape(E * capacity, D), slot_of_tok.reshape(-1),
+            axis=0,
+        ).reshape(N, cfg.moe_top_k, D)
+        out = jnp.sum(picked * w_of_tok[..., None].astype(x.dtype), axis=1)
+    else:
+        out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
     aux = metrics["aux_loss"] + (cfg.moe_z_loss_coef / max(cfg.moe_aux_loss_coef, 1e-9)) * metrics["z_loss"]
     out = out.reshape(B, S, D)
 
