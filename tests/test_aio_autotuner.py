@@ -102,7 +102,11 @@ def test_autotuner_picks_best():
     )
     best = tuner.tune()
     assert best["micro_batch"] in (1, 2)
-    assert best["remat_policy"] in ("none", "attn_mlp", "full")
+    # any searched policy can win — CPU timing under load is not stable
+    # enough to pin the winner (observed: dots_flash beating none)
+    from deepspeed_tpu.autotuning.autotuner import REMAT_POLICIES
+
+    assert best["remat_policy"] in REMAT_POLICIES
     assert best["throughput"] > 0
     assert len(tuner.results) >= 2
 
