@@ -102,11 +102,11 @@ def test_autotuner_picks_best():
     )
     best = tuner.tune()
     assert best["micro_batch"] in (1, 2)
-    # any searched policy can win — CPU timing under load is not stable
-    # enough to pin the winner (observed: dots_flash beating none)
-    from deepspeed_tpu.autotuning.autotuner import REMAT_POLICIES
-
-    assert best["remat_policy"] in REMAT_POLICIES
+    # any searched policy can win a CPU timing race (observed: dots_flash
+    # beating none under load) — the invariant with teeth is that the
+    # returned winner IS the max-throughput record of the search
+    top = max(tuner.results, key=lambda r: r["throughput"])
+    assert best == top, (best, top)
     assert best["throughput"] > 0
     assert len(tuner.results) >= 2
 
