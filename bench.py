@@ -58,6 +58,13 @@ def enable_compile_cache():
         pass
 
 
+def bench_dims(smoke: bool):
+    """(B, S) of the bench batch, computable without touching jax — the
+    sweep parent needs the grid geometry while the model only ever
+    compiles inside per-point child processes."""
+    return (4, 256) if smoke else (8, 2048)
+
+
 def bench_model_and_data(smoke: bool):
     """The benchmark model: ONE definition shared by bench.py and the
     operator sweep (tools/sweep_train.py) so "best sweep config" always
@@ -67,7 +74,7 @@ def bench_model_and_data(smoke: bool):
     matmuls at half MXU utilization: measured 1.6x slower end-to-end)."""
     from deepspeed_tpu.models import llama
 
-    B, S = (4, 256) if smoke else (8, 2048)
+    B, S = bench_dims(smoke)
     model = llama(
         "llama-tiny",
         vocab_size=1024 if smoke else 32768,

@@ -45,7 +45,10 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     Pallas decode path)."""
     shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.hd)
     if quantized:
-        sshape = (cfg.num_layers, batch, max_len, cfg.kv_heads, SCALE_LANES)
+        # scales live pre-transposed as [B, KV, Smax, SL]: the Pallas decode
+        # kernel consumes (Smax, SL) trailing blocks directly, so the
+        # latency-critical decode step never pays a per-token relayout
+        sshape = (cfg.num_layers, batch, cfg.kv_heads, max_len, SCALE_LANES)
         return {
             "k": jnp.zeros(shape, jnp.int8),
             "v": jnp.zeros(shape, jnp.int8),
@@ -104,8 +107,14 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
         vq, vs = _quantize_kv(v)
         k_cache = lax.dynamic_update_slice(k_cache, kq, (0, cache_len, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, vq, (0, cache_len, 0, 0))
-        k_scale = lax.dynamic_update_slice(k_scale, ks, (0, cache_len, 0, 0))
-        v_scale = lax.dynamic_update_slice(v_scale, vs, (0, cache_len, 0, 0))
+        # new-token scales transpose into the [B, KV, S, SL] cache layout —
+        # tiny ([B,S,KV,SL]); the big int8 value caches never relayout
+        k_scale = lax.dynamic_update_slice(
+            k_scale, jnp.swapaxes(ks, 1, 2), (0, 0, cache_len, 0)
+        )
+        v_scale = lax.dynamic_update_slice(
+            v_scale, jnp.swapaxes(vs, 1, 2), (0, 0, cache_len, 0)
+        )
     else:
         k_cache = lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
@@ -160,8 +169,10 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     if quantized:
-        kf = kf * k_scale[..., :1]
-        vf = vf * v_scale[..., :1]
+        # scale cache is [B, KV, Smax, SL]; align to the [B, Smax, KV, hd]
+        # value layout for the dense dequant (fallback path only)
+        kf = kf * jnp.swapaxes(k_scale, 1, 2)[..., :1]
+        vf = vf * jnp.swapaxes(v_scale, 1, 2)[..., :1]
     if nkv != nh:
         kf = jnp.repeat(kf, nh // nkv, axis=2)
         vf = jnp.repeat(vf, nh // nkv, axis=2)

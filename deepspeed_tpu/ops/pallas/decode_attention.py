@@ -28,6 +28,7 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_S = 256
 
 
+
 def _decode_kernel(*refs, scale, block_s, has_scales=False):
     if has_scales:
         (q_ref, k_ref, v_ref, ks_ref, vs_ref, cl_ref, o_ref,
@@ -50,12 +51,12 @@ def _decode_kernel(*refs, scale, block_s, has_scales=False):
     @pl.when(start <= cl)  # skip tiles entirely past the live cache
     def _body():
         q = q_ref[0, 0]  # [G, hd]
-        k = k_ref[0, :, 0, :]  # [block_s, hd] (storage dtype)
-        v = v_ref[0, :, 0, :]
+        k = k_ref[0]  # [block_s, hd] (storage dtype; flat head-column view)
+        v = v_ref[0]
         if has_scales:
             # int8 cache: dequantize the tile with its per-token scales
-            k = (k.astype(jnp.float32) * ks_ref[0, :, 0, :][:, :1]).astype(q.dtype)
-            v = (v.astype(jnp.float32) * vs_ref[0, :, 0, :][:, :1]).astype(q.dtype)
+            k = (k.astype(jnp.float32) * ks_ref[0, 0][:, :1]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[0, 0][:, :1]).astype(q.dtype)
         elif k.dtype != q.dtype:
             # mixed storage (kv_cache_dtype="bf16" on an fp32 engine): the
             # MXU matmul needs matching operand dtypes
@@ -103,8 +104,9 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
 
     cache_len: scalar int32 — the new token's position (tokens already
     cached). Returns [B,1,H,hd]. Caller guarantees the new token's k/v are
-    already written at ``cache_len``. int8 caches pass per-token scales
-    [B,Smax,KV,SCALE_LANES]; dequant happens on the tile in VMEM.
+    already written at ``cache_len``. int8 caches pass per-token scales in
+    the storage layout [B,KV,Smax,SCALE_LANES]; dequant happens on the tile
+    in VMEM.
     """
     B, one, H, hd = q.shape
     assert one == 1, "decode kernel is single-token"
@@ -119,18 +121,32 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
     ns = Smax // bs
     has_scales = k_scale is not None
 
-    operands = [qg, k_cache, v_cache]
+    # The TPU lowering requires each block's last-two dims to be (8,128)-
+    # divisible or equal to the array dims, so a per-head [bs, hd] tile of a
+    # [B, Smax, KV, hd] cache is illegal (head block 1 < KV). Instead view
+    # the cache as [B, Smax, KV*hd] — a free contiguous reshape — and slice
+    # head kv as the hd-wide column block at index kv, which is lane-aligned
+    # whenever hd % 128 == 0 (or KV == 1, where the block spans the row).
+    operands = [
+        qg,
+        k_cache.reshape(B, Smax, KV * hd),
+        v_cache.reshape(B, Smax, KV * hd),
+    ]
     in_specs = [
         pl.BlockSpec((1, 1, G, hd), lambda b, kv, si: (b, kv, 0, 0)),
-        pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
-        pl.BlockSpec((1, bs, 1, hd), lambda b, kv, si: (b, si, kv, 0)),
+        pl.BlockSpec((1, bs, hd), lambda b, kv, si: (b, si, kv)),
+        pl.BlockSpec((1, bs, hd), lambda b, kv, si: (b, si, kv)),
     ]
     if has_scales:
+        # scales arrive pre-transposed as [B, KV, Smax, SL] (the cache's
+        # storage layout — see models/decoding.init_cache), giving a legal
+        # (bs, SL) trailing block (SL equals the array dim) with no
+        # per-token relayout on the decode path
         SL = k_scale.shape[-1]
         operands += [k_scale, v_scale]
         in_specs += [
-            pl.BlockSpec((1, bs, 1, SL), lambda b, kv, si: (b, si, kv, 0)),
-            pl.BlockSpec((1, bs, 1, SL), lambda b, kv, si: (b, si, kv, 0)),
+            pl.BlockSpec((1, 1, bs, SL), lambda b, kv, si: (b, kv, si, 0)),
+            pl.BlockSpec((1, 1, bs, SL), lambda b, kv, si: (b, kv, si, 0)),
         ]
     operands.append(cl)
     in_specs.append(
@@ -171,20 +187,39 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
     topo = current_topology()
     distributed = topo is not None and topo.world_size > 1
     tp = topo.tp_size if distributed else 1
-    if (
-        one != 1
-        or H % KV != 0
-        or hd % 8 != 0
-        or _pick_block(Smax, DEFAULT_BLOCK_S) is None
-        or (distributed and (H % tp != 0 or KV % tp != 0))
-        or (distributed and (H // tp) % max(KV // tp, 1) != 0)
-    ):
+    interp = interpret if interpret is not None else (
+        jax.default_backend() != "tpu"
+    )
+    reasons = []
+    if one != 1:
+        reasons.append(f"{one} query tokens (kernel is single-token)")
+    if H % KV != 0:
+        reasons.append(f"H={H} not a multiple of KV={KV}")
+    if hd % 8 != 0:
+        reasons.append(f"head_dim {hd} not 8-aligned")
+    if _pick_block(Smax, DEFAULT_BLOCK_S) is None:
+        reasons.append(f"cache length {Smax} has no 8-aligned block")
+    if not interp and hd % LANES != 0 and KV // max(tp, 1) != 1:
+        # the flat head-column view needs lane-aligned per-head offsets on
+        # the real TPU lowering (interpret mode has no such constraint)
+        reasons.append(
+            f"head_dim {hd} not {LANES}-aligned with {KV // max(tp, 1)} "
+            "local cache heads"
+        )
+    if distributed and (H % tp != 0 or KV % tp != 0):
+        reasons.append(f"H={H}/KV={KV} not divisible by tp={tp}")
+    elif distributed and (H // tp) % max(KV // tp, 1) != 0:
+        reasons.append(f"GQA group uneven under tp={tp}")
+    if reasons:
+        from ...utils.logging import log_fallback_once
+
+        log_fallback_once("decode_attention", reasons)
         return None
 
     if not distributed:
         return decode_attention_kernel(
             q, k_cache, v_cache, cache_len,
-            k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+            k_scale=k_scale, v_scale=v_scale, interpret=interp,
         )
 
     from jax import shard_map
@@ -194,13 +229,13 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
     b_ax = batch_axes if batch_axes else None
     h_ax = "tp" if tp > 1 else None
     has_scales = k_scale is not None
-    # scales are [B, Smax, KV, SCALE_LANES]: head dim 2 follows tp
     kv_spec = P(b_ax, None, h_ax, None)
     operands = [q, k_cache, v_cache]
     in_specs = [P(b_ax, None, h_ax, None), kv_spec, kv_spec]
     if has_scales:
+        # scales are [B, KV, Smax, SCALE_LANES]: head dim 1 follows tp
         operands += [k_scale, v_scale]
-        in_specs += [kv_spec, kv_spec]
+        in_specs += [P(b_ax, h_ax, None, None), P(b_ax, h_ax, None, None)]
     operands.append(jnp.asarray(cache_len, jnp.int32))
     in_specs.append(P())
 
@@ -211,7 +246,7 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
             (cl,) = rest
             ks = vs = None
         return decode_attention_kernel(
-            q, kc, vc, cl, k_scale=ks, v_scale=vs, interpret=interpret
+            q, kc, vc, cl, k_scale=ks, v_scale=vs, interpret=interp
         )
 
     return shard_map(

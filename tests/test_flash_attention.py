@@ -462,8 +462,9 @@ class _LogCapture:
 
 def test_ineligible_bias_falls_back_with_log():
     from deepspeed_tpu.ops.pallas import flash_attention as fa_mod
+    from deepspeed_tpu.utils import logging as logging_mod
 
-    fa_mod._logged_fallbacks.clear()
+    logging_mod.fallback_log_seen.clear()
     q, k, v = _qkv(jax.random.PRNGKey(16), B=2, S=256, H=4, D=64)
     # per-head bias missing the batch dim → not in-kernel-eligible → XLA
     # fallback, with exactly ONE log line naming the reason
@@ -480,8 +481,9 @@ def test_ineligible_bias_falls_back_with_log():
 
 def test_unaligned_seq_fallback_names_reason():
     from deepspeed_tpu.ops.pallas import flash_attention as fa_mod
+    from deepspeed_tpu.utils import logging as logging_mod
 
-    fa_mod._logged_fallbacks.clear()
+    logging_mod.fallback_log_seen.clear()
     rng = jax.random.PRNGKey(18)
     q = jax.random.normal(rng, (1, 100, 2, 64))
     with _LogCapture() as cap:

@@ -429,8 +429,11 @@ def test_decode_attention_kernel_int8_scales_in_kernel():
     assert kq.dtype == jnp.int8 and ks.shape == (B, Smax, KV, SCALE_LANES)
 
     for cache_len in (5, 130, 511):
+        # the kernel consumes scales in the cache's storage layout
+        # [B, KV, Smax, SL] (models/decoding.init_cache)
         out = decode_attention_kernel(
-            q, kq, vq, jnp.asarray(cache_len), k_scale=ks, v_scale=vs
+            q, kq, vq, jnp.asarray(cache_len),
+            k_scale=jnp.swapaxes(ks, 1, 2), v_scale=jnp.swapaxes(vs, 1, 2),
         )
         kf = kq.astype(jnp.float32) * ks[..., :1]
         vf = vq.astype(jnp.float32) * vs[..., :1]

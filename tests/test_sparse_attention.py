@@ -199,9 +199,10 @@ def test_traced_block_mask_falls_back_with_reason():
     import jax.numpy as jnp
 
     from deepspeed_tpu.ops.pallas import flash_attention as fa_mod
+    from deepspeed_tpu.utils import logging as logging_mod
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-    fa_mod._logged_fallbacks.clear()
+    logging_mod.fallback_log_seen.clear()
     rng = jax.random.PRNGKey(0)
     q = jax.random.normal(rng, (1, 256, 2, 64))
     # non-trivial layout: dropping it would NOT reproduce dense attention
@@ -215,5 +216,6 @@ def test_traced_block_mask_falls_back_with_reason():
     out = run(q, jnp.asarray(layout))  # mask is a tracer inside jit
     ref = dense_blocksparse_reference(q, q, q, layout, 128, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
-    reasons = [r for key in fa_mod._logged_fallbacks for r in key]
+    reasons = [r for key in logging_mod.fallback_log_seen
+               for r in key[1]]
     assert any("trace-time static" in r for r in reasons), reasons

@@ -9,9 +9,18 @@ the raw record, and a ds_config `config_patch` that merges straight into
 this file, so a committed sweep means the bench never burns a known-doomed
 compile again.
 
+Each grid point runs in its OWN child process (the reference autotuner also
+launches every experiment as a separate ranked process): on a 16GB chip an
+OOM can leave the in-process backend client wedged, after which every later
+candidate fails instantly with the same RESOURCE_EXHAUSTED — observed as a
+whole sweep of spurious "OOM, pruned" rows. A fresh process per point makes
+candidates independent; a hung relay call costs one child its timeout, not
+the sweep.
+
 Usage:    python tools/sweep_train.py            # default grid
           python tools/sweep_train.py --quick    # 3 configs
           python tools/sweep_train.py --no-write # don't update SWEEP_BEST
+          python tools/sweep_train.py --in-process  # old single-process mode
 CPU smoke: BENCH_SMOKE=1 (tiny model, interpret kernels).
 """
 
@@ -19,40 +28,24 @@ import argparse
 import itertools
 import json
 import os
+import subprocess
 import sys
 
 REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_DIR)
 
 SWEEP_BEST = os.path.join(REPO_DIR, "SWEEP_BEST.json")
+POINT_TIMEOUT_S = 600  # compile + trials for one candidate, relay included
+PROBE_TIMEOUT_S = 120  # tiny device-count child; a wedged pool fails fast
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--no-write", action="store_true",
-                    help="don't update SWEEP_BEST.json")
-    args = ap.parse_args()
-
-    import jax
-
+def build_tuner():
     from bench import bench_model_and_data, enable_compile_cache, smoke_mode
-    from deepspeed_tpu.autotuning.autotuner import (
-        Autotuner, result_to_config_patch,
-    )
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
 
     smoke = smoke_mode()
     enable_compile_cache()
     model, data, B, S = bench_model_and_data(smoke)
-    # batch triangle: B == micro * accum * dp, so micro tops out at B // dp
-    dp = max(len(jax.devices()), 1)
-    mb_full = max(B // dp, 1)
-    micros = [mb_full, max(mb_full // 2, 1)]
-    policies = ["none", "dots_flash", "dots_saveable"]
-    tiles = [(0, 0), (512, 512)]
-    grid = list(itertools.product(micros, policies, tiles))
-    if args.quick or smoke:
-        grid = grid[:3]
 
     def sample_batch(train_batch_size):
         # grid micros divide B: accum = B // (micro * dp) keeps the global
@@ -73,23 +66,152 @@ def main():
         },
         sample_batch_fn=sample_batch,
     )
+    return tuner, B, S, smoke
+
+
+def device_count_subprocess() -> int:
+    """Device count via a throwaway child: the parent must never hold the
+    TPU client itself — a local chip is process-exclusive and the children
+    are the ones that need it. A failed probe aborts the sweep: guessing
+    dp=1 on a multi-device machine would fail the batch triangle in every
+    child and record a full grid of spurious error rows."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()), jax.default_backend())"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        n, backend = (proc.stdout or "").strip().splitlines()[-1].split()
+        if backend == "cpu" and "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+            # jax fell back to CPU (e.g. the accelerator is transiently
+            # held) — trusting its device count would hand the children a
+            # wrong dp and fail the batch triangle on every point
+            raise SystemExit(
+                "sweep: device probe landed on the CPU backend but "
+                "JAX_PLATFORMS does not request cpu; refusing to guess dp"
+            )
+        return max(int(n), 1)
+    except SystemExit:
+        raise
+    except Exception as e:
+        tail = ""
+        if isinstance(e, subprocess.TimeoutExpired):
+            tail = f"probe timed out after {PROBE_TIMEOUT_S}s"
+        elif "proc" in locals():
+            tail = (proc.stderr or "").strip().splitlines()[-1:]
+            tail = tail[0] if tail else repr(e)
+        else:
+            tail = repr(e)
+        raise SystemExit(f"sweep: device probe failed ({tail}); "
+                         "is the accelerator pool up?")
+
+
+def default_grid(B, dp):
+    # batch triangle: B == micro * accum * dp, so micro tops out at B // dp
+    mb_full = max(B // dp, 1)
+    micros = [mb_full, max(mb_full // 2, 1)]
+    policies = ["none", "dots_flash", "dots_saveable"]
+    tiles = [(0, 0), (512, 512)]
+    return list(itertools.product(micros, policies, tiles))
+
+
+def run_one(point_csv: str) -> None:
+    """Child mode: measure exactly one (micro, policy, bq, bk) point and
+    print its record as the final JSON line."""
+    micro, pol, bq, bk = point_csv.split(",")
+    tuner, _, _, _ = build_tuner()
+    [rec] = tuner.measure_grid([(int(micro), pol, (int(bq), int(bk)))])
+    print("SWEEP_POINT " + json.dumps(rec), flush=True)
+
+
+def measure_point_subprocess(point):
+    micro, pol, (bq, bk) = point
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--one", f"{micro},{pol},{bq},{bk}"]
+    rec = {"micro_batch": int(micro), "remat_policy": pol,
+           "flash_block_q": int(bq), "flash_block_k": int(bk)}
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, cwd=REPO_DIR,
+            timeout=POINT_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        rec.update(throughput=None, error=f"timeout {POINT_TIMEOUT_S}s")
+        return rec
+    for line in reversed((proc.stdout or "").splitlines()):
+        if line.startswith("SWEEP_POINT "):
+            return json.loads(line[len("SWEEP_POINT "):])
+    tail = ((proc.stderr or "") + (proc.stdout or "")).strip().splitlines()
+    rec.update(throughput=None,
+               error=f"child rc={proc.returncode}: "
+                     + (tail[-1][:160] if tail else "no output"))
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-write", action="store_true",
+                    help="don't update SWEEP_BEST.json")
+    ap.add_argument("--in-process", action="store_true",
+                    help="measure every point in this process (no isolation)")
+    ap.add_argument("--one", default=None, metavar="MICRO,POLICY,BQ,BK",
+                    help="child mode: measure one point and exit")
+    args = ap.parse_args()
+
+    if args.one:
+        run_one(args.one)
+        return
+
+    from bench import smoke_mode
+
+    smoke = smoke_mode()
+    in_process = args.in_process or smoke  # smoke: child spawn is overhead
+    if in_process:
+        tuner, B, S, smoke = build_tuner()
+        import jax
+
+        grid = default_grid(B, max(len(jax.devices()), 1))
+    else:
+        # the parent only needs the grid geometry; the model compiles in
+        # the children. B/S come from the bench definition without jax.
+        from bench import bench_dims
+
+        B, S = bench_dims(smoke)
+        grid = default_grid(B, device_count_subprocess())
+    if args.quick or smoke:
+        grid = grid[:3]
+
+    from deepspeed_tpu.autotuning.autotuner import result_to_config_patch
+
+    write = not args.no_write and not smoke
+
+    def save_best(best):
+        out = {"best": best}
+        if best is not None:
+            out["config_patch"] = result_to_config_patch(best)
+        if best is not None and write:
+            # incremental: a stage-level kill (campaign timeout, pool drop)
+            # must not discard points already measured
+            with open(SWEEP_BEST, "w") as f:
+                json.dump(out, f, indent=1)
+        return out
 
     best = None
-    for rec in tuner.measure_grid(grid):
+    for point in grid:
+        if in_process:
+            [rec] = tuner.measure_grid([point])
+        else:
+            rec = measure_point_subprocess(point)
         if rec.get("throughput"):
             rec = dict(rec, step_s=round(B * S / rec["throughput"], 4),
                        tok_s=round(rec["throughput"], 1))
             if best is None or rec["tok_s"] > best["tok_s"]:
                 best = rec
+                save_best(best)
         print(json.dumps(rec), flush=True)
 
-    out = {"best": best}
-    if best is not None:
-        out["config_patch"] = result_to_config_patch(best)
-    print(json.dumps(out))
-    if best is not None and not args.no_write and not smoke:
-        with open(SWEEP_BEST, "w") as f:
-            json.dump(out, f, indent=1)
+    print(json.dumps(save_best(best)))
 
 
 if __name__ == "__main__":
