@@ -107,15 +107,16 @@ class Autotuner:
             batch = self.sample_batch_fn(cfg["train_batch_size"])
             # stage once: per-step device_put is a blocking relay RPC
             staged = engine.prepare_batch(dict(batch))
-            for _ in range(self.start_step):  # compile + warmup
-                engine.train_batch(batch=staged)
-            float(engine.state.step)  # settle before the timed region
+            # the scanned chain is the program bench.py times: one dispatch
+            # and one readback per trial, and only ONE compile per candidate
+            # (the single-step program never compiles)
             chain = max(self.end_step - self.start_step, 1)
+            engine.train_batch_chain(batch=staged, steps=chain)  # compile
+            float(engine.state.step)  # settle before the timed region
             trials = []
             for _ in range(self.trials):
                 t0 = time.perf_counter()
-                for _ in range(chain):
-                    engine.train_batch(batch=staged)
+                engine.train_batch_chain(batch=staged, steps=chain)
                 float(engine.state.step)  # one readback per chain
                 trials.append((time.perf_counter() - t0) / chain)
             dt = float(np.median(trials))

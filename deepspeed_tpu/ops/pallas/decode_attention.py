@@ -28,7 +28,6 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_S = 256
 
 
-
 def _decode_kernel(*refs, scale, block_s, has_scales=False):
     if has_scales:
         (q_ref, k_ref, v_ref, ks_ref, vs_ref, cl_ref, o_ref,

@@ -157,6 +157,8 @@ class TpuEngine:
         self.training = True
         self._micro_buffer = []
         self._metrics = {}
+        self._chain_fns: Dict[Any, Any] = {}
+        self.last_chain_metrics = None
         self.monitor = None
         if config.monitor.enabled:
             from ..monitor.monitor import MonitorMaster
@@ -1000,39 +1002,202 @@ class TpuEngine:
             from ..utils.memory import see_memory_usage
 
             see_memory_usage(f"step {self.global_steps}")
+        self._emit_step_log(metrics, self.global_steps)
+        self.tput.stop()
+        return metrics["loss"]
+
+    def _emit_step_log(self, metrics, step_no: int):
+        """Monitor events + steps_per_print log line for one step's metrics
+        (no-op off the print boundary). Shared by train_batch and the
+        scanned chain, which replays it for every boundary it crossed."""
+        if step_no % self.config.steps_per_print != 0:
+            return
         show_moe = "moe_aux_loss" in metrics and getattr(
             getattr(self.model, "config", None), "is_moe", False
         )
-        if self.monitor and self.global_steps % self.config.steps_per_print == 0:
+        if self.monitor:
             events = [
-                ("Train/loss", float(metrics["loss"]), self.global_steps),
-                ("Train/lr", float(metrics["lr"]), self.global_steps),
-                ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
+                ("Train/loss", float(metrics["loss"]), step_no),
+                ("Train/lr", float(metrics["lr"]), step_no),
+                ("Train/grad_norm", float(metrics["grad_norm"]), step_no),
             ]
             if show_moe:
                 events.append((
                     "Train/moe_aux_loss", float(metrics["moe_aux_loss"]),
-                    self.global_steps,
+                    step_no,
                 ))
             if self.tput.avg_samples_per_sec > 0:
                 events.append((
                     "Train/samples_per_sec", self.tput.avg_samples_per_sec,
-                    self.global_steps,
+                    step_no,
                 ))
             self.monitor.write_events(events)
-        elif self.global_steps % self.config.steps_per_print == 0:
+        else:
             aux = (
                 f" moe_aux={float(metrics['moe_aux_loss']):.4f}" if show_moe else ""
             )
             sps = self.tput.avg_samples_per_sec
             tput = f" samples/sec={sps:.1f}" if sps > 0 else ""
             log_dist(
-                f"step {self.global_steps}: loss={float(metrics['loss']):.4f} "
+                f"step {step_no}: loss={float(metrics['loss']):.4f} "
                 f"lr={float(metrics['lr']):.3e} gnorm={float(metrics['grad_norm']):.3f}"
                 f"{aux}{tput}"
             )
-        self.tput.stop()
-        return metrics["loss"]
+
+    def _chain_eligible(self):
+        """Host logic that must run BETWEEN steps disqualifies the scanned
+        chain; everything else (lr schedule, PLD keep-probs, fp16 scale
+        updates, overflow skip) is traced from the step carry and scans
+        fine."""
+        reasons = []
+        if self.random_ltd is not None:
+            reasons.append("random-LTD anneal picks a static keep per step")
+        if self.curriculum is not None and self.curriculum.curriculum_type == "seqlen":
+            reasons.append("seqlen curriculum reshapes the batch on host")
+        if self._nvme_swapper is not None:
+            reasons.append("NVMe offload swaps optimizer shards between "
+                           "the grads and update programs")
+        return reasons
+
+    def _jit_chain(self, steps: int, stacked: bool):
+        key = (steps, stacked)
+        fn = self._chain_fns.get(key)
+        if fn is not None:
+            return fn
+
+        def chain(params, opt_state, loss_scale, step, data, rng):
+            def body(carry, x):
+                p, o, s, st, r = carry
+                mb = x if stacked else data
+                # split exactly as next_rng() does, so a chain is
+                # bit-identical to the same steps dispatched one by one
+                r, key = jax.random.split(r)
+                p, o, s, st, m = self._train_step(p, o, s, st, mb, key, None)
+                return (p, o, s, st, r), m
+
+            xs = data if stacked else None
+            (p, o, s, st, r), ms = jax.lax.scan(
+                body, (params, opt_state, loss_scale, step, rng), xs,
+                length=None if stacked else steps,
+            )
+            return p, o, s, st, r, ms
+
+        fn = jax.jit(
+            chain,
+            donate_argnums=(0, 1, 2, 3),
+            out_shardings=(*self._state_shardings, None, None),
+        )
+        self._chain_fns[key] = fn
+        return fn
+
+    def train_batch_chain(self, batch=None, data_iter=None, steps: int = 1):
+        """Run ``steps`` optimizer steps as ONE jitted program: a
+        ``lax.scan`` over the train step, so the whole chain costs a single
+        host dispatch (and, through a network relay, a single RPC).
+
+        The reference amortizes per-step launch overhead with CUDA graphs
+        and fused multi-tensor ops; on TPU the native equivalent is
+        compiling the loop itself. With ``batch=`` the same (optionally
+        pre-staged) global batch feeds every step — the steady-state shape
+        benchmarks measure. With ``data_iter=`` the next ``steps`` host
+        batches upload as one stacked transfer and scan through.
+
+        Features that need host logic between steps (random-LTD anneal,
+        seqlen curriculum, NVMe swap windows) fall back to per-step
+        ``train_batch`` calls transparently. Returns the stacked per-step
+        loss array ([steps]); full stacked metrics land in
+        ``engine.last_chain_metrics``.
+        """
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        reasons = self._chain_eligible()
+        if reasons or steps == 1:
+            if reasons:
+                log_dist(
+                    "train_batch_chain: per-step fallback: "
+                    + "; ".join(reasons)
+                )
+            losses = [
+                self.train_batch(batch=batch, data_iter=data_iter)
+                for _ in range(steps)
+            ]
+            self.last_chain_metrics = None
+            return jnp.stack([jnp.asarray(ls) for ls in losses])
+
+        from ..models.transformer import make_lm_batch
+
+        stacked = data_iter is not None
+        if stacked:
+            # stack the N host batches FIRST and upload each field once as
+            # one [steps, accum, micro, ...] transfer — per-batch device_put
+            # is exactly the blocking-RPC-per-step cost the chain removes.
+            # Labels shift on host for the same reason.
+            accum = self.config.gradient_accumulation_steps
+            expect = self.config.train_batch_size
+            host_steps = []
+            for _ in range(steps):
+                b = {k: np.asarray(v) for k, v in
+                     self._next_batch(data_iter).items()}
+                if "labels" not in b:
+                    ids = b["input_ids"]
+                    b["labels"] = np.concatenate(
+                        [ids[:, 1:],
+                         np.full((ids.shape[0], 1), -1, ids.dtype)], axis=1
+                    )
+                host_steps.append(b)
+            sharding = NamedSharding(
+                self.topology.mesh, P(None, None, *tuple(self.topology.batch_spec()))
+            )
+            data = {}
+            for k in host_steps[0]:
+                arrs = [b[k] for b in host_steps]
+                for a in arrs:
+                    if a.shape[0] != expect:
+                        raise ValueError(
+                            f"batch field {k!r} has batch {a.shape[0]}, "
+                            f"config train_batch_size={expect}"
+                        )
+                data[k] = jax.device_put(
+                    np.stack([
+                        a.reshape(accum, expect // accum, *a.shape[1:])
+                        for a in arrs
+                    ]),
+                    sharding,
+                )
+        else:
+            if batch is None:
+                raise ValueError("train_batch_chain needs batch or data_iter")
+            if "labels" not in batch:
+                batch = make_lm_batch(jnp.asarray(batch["input_ids"]))
+            data = self._prepare_batch(batch)
+
+        self.tput.start()
+        with use_topology(self.topology):
+            p, o, s, st, self._rng, ms = self._jit_chain(steps, stacked)(
+                *self.state.astuple(), data, self._rng
+            )
+        start = self.global_steps
+        self.state = TrainState(p, o, s, st)
+        self.global_steps += steps
+        self.micro_steps += steps * self.config.gradient_accumulation_steps
+        self.last_chain_metrics = ms
+        # expose the final step's metrics where train_batch puts them
+        self._metrics = {k: v[-1] for k, v in ms.items()}
+        if self.fp16_enabled:
+            skipped = int(np.sum(np.asarray(ms["overflow"])))
+            if skipped:
+                self.skipped_steps += skipped
+                log_dist(
+                    f"chain of {steps}: {skipped} fp16-overflow steps skipped"
+                )
+        self.tput.stop(steps=steps)
+        # replay monitor/print output for every boundary inside the chain
+        for i in range(steps):
+            if (start + i + 1) % self.config.steps_per_print == 0:
+                self._emit_step_log(
+                    {k: v[i] for k, v in ms.items()}, start + i + 1
+                )
+        return ms["loss"]
 
     def _next_batch(self, data_iter):
         """Pull the next batch: accepts a batch dict, an iterator, or an
@@ -1236,3 +1401,17 @@ class TpuEngine:
         if self._nvme_swapper is not None:
             self._nvme_swapper.close()
             self._nvme_swapper = None
+        # Free device buffers NOW rather than at the GC's leisure: an engine
+        # holds params + optimizer state (~6x param bytes at fp32 master),
+        # and tuner loops that build engines back-to-back on a 16GB chip OOM
+        # on the *next* candidate when the previous state lingers. Deleting
+        # is safe — the engine is defunct after destroy().
+        state, self.state = self.state, None
+        if state is not None:
+            # TrainState is not a registered pytree — walk its tuple form
+            for leaf in jax.tree_util.tree_leaves(state.astuple()):
+                if isinstance(leaf, jax.Array):
+                    try:
+                        leaf.delete()
+                    except Exception:  # noqa: BLE001 — already-deleted/donated
+                        pass

@@ -83,12 +83,14 @@ class ThroughputTimer:
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def stop(self, block_on=None) -> None:
+    def stop(self, block_on=None, steps: int = 1) -> None:
+        """``steps`` > 1 credits one timed interval to that many optimizer
+        steps (scanned chains run N steps per dispatch)."""
         if self._t0 is None:
             return
         if block_on is not None:
             jax.block_until_ready(block_on)
-        self.step_count += 1
+        self.step_count += steps
         if self.step_count >= self.start_step:
             self.total_elapsed += time.perf_counter() - self._t0
         self._t0 = None

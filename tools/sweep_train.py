@@ -112,7 +112,23 @@ def default_grid(B, dp):
     micros = [mb_full, max(mb_full // 2, 1)]
     policies = ["none", "dots_flash", "dots_saveable"]
     tiles = [(0, 0), (512, 512)]
-    return list(itertools.product(micros, policies, tiles))
+    grid = list(itertools.product(micros, policies, tiles))
+    # the committed winner's neighborhood measures FIRST: the pool drops
+    # without warning, and the incremental SWEEP_BEST write means a partial
+    # window still refreshes a good seed instead of a pile of OOM rows
+    try:
+        with open(SWEEP_BEST) as f:
+            seed = (json.load(f) or {}).get("best") or {}
+        s_mb, s_pol = int(seed["micro_batch"]), str(seed["remat_policy"])
+
+        def rank(point):
+            mb, pol, _ = point
+            return (mb != s_mb, pol != s_pol)
+
+        grid.sort(key=rank)
+    except Exception:
+        pass
+    return grid
 
 
 def run_one(point_csv: str) -> None:

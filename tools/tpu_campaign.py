@@ -7,10 +7,16 @@ each stage in a fresh subprocess under its own timeout (a hung relay call
 can't wedge the campaign), streaming everything into ``perf/``:
 
   1. probe     — tiny op + readback (exit 2 if the pool is down)
-  2. sweep     — tools/sweep_train.py full grid → SWEEP_BEST.json + jsonl
-  3. bench     — bench.py (ladder seeded by the fresh sweep) → json
-  4. decode    — tools/bench_decode.py grid over dtype x kv x inject x spec
-  5. profile   — engine.profile_step() xprof trace at the sweep-best config
+  2. bench     — bench.py (ladder seeded by the committed sweep) → json
+  3. profile   — engine.profile_step() xprof trace at the sweep-best config
+  4. sweep     — tools/sweep_train.py full grid → SWEEP_BEST.json + jsonl
+  5. decode    — tools/bench_decode.py grid over dtype x kv x inject x spec
+
+Stage order is cheapest-headline-first: the pool drops without warning, so
+the driver-facing bench number and the MFU-gap xprof trace are banked
+before the long sweep/decode tails. The sweep refreshing SWEEP_BEST only
+benefits the NEXT bench run — an acceptable trade for never losing the
+record to a mid-campaign outage.
 
 Usage:  python tools/tpu_campaign.py [--quick] [--skip probe,sweep,...]
 Artifacts land in perf/ — commit them.
@@ -111,22 +117,33 @@ def main():
             print("[campaign] pool is DOWN; aborting (exit 2)", flush=True)
             return 2
 
-    # 2. sweep — refreshes SWEEP_BEST.json, which seeds stage 3's ladder
-    if "sweep" not in skip:
-        cmd = [PY, "tools/sweep_train.py"] + (["--quick"] if args.quick else [])
-        results.append(run_stage("sweep", cmd,
-                                 os.path.join(PERF, "sweep.jsonl"),
-                                 timeout=5400))
-        save_manifest()
-
-    # 3. bench — the driver-facing record
+    # 2. bench — the driver-facing record, banked first (ladder seeded by
+    # the committed SWEEP_BEST.json)
     if "bench" not in skip:
         results.append(run_stage("bench", [PY, "bench.py"],
                                  os.path.join(PERF, "bench.json"),
                                  timeout=3600))
         save_manifest()
 
-    # 4. decode grid (reference headline: DeepSpeed-Inference serving)
+    # 3. xprof at the sweep-best config — the step-gap localizer, banked
+    # before the long sweep/decode tails
+    if "profile" not in skip:
+        trace = os.path.join(PERF, "xprof_trace")
+        src = PROFILE_SRC.format(repo=REPO, trace=trace)
+        results.append(run_stage("profile", [PY, "-c", src],
+                                 os.path.join(PERF, "profile.log"),
+                                 timeout=3600))
+        save_manifest()
+
+    # 4. sweep — refreshes SWEEP_BEST.json for the NEXT bench run
+    if "sweep" not in skip:
+        cmd = [PY, "tools/sweep_train.py"] + (["--quick"] if args.quick else [])
+        results.append(run_stage("sweep", cmd,
+                                 os.path.join(PERF, "sweep.jsonl"),
+                                 timeout=9000))
+        save_manifest()
+
+    # 5. decode grid (reference headline: DeepSpeed-Inference serving)
     if "decode" not in skip:
         grid = [
             [],                                      # bf16 baseline
@@ -147,15 +164,6 @@ def main():
                 timeout=2400,
             ))
             save_manifest()
-
-    # 5. xprof at the sweep-best config — the step-gap localizer
-    if "profile" not in skip:
-        trace = os.path.join(PERF, "xprof_trace")
-        src = PROFILE_SRC.format(repo=REPO, trace=trace)
-        results.append(run_stage("profile", [PY, "-c", src],
-                                 os.path.join(PERF, "profile.log"),
-                                 timeout=3600))
-        save_manifest()
 
     bad = [r for r in results if r["rc"] != 0]
     print(f"[campaign] done: {len(results) - len(bad)}/{len(results)} stages "
