@@ -1,0 +1,60 @@
+#!/bin/bash
+# After a completed campaign refreshed SWEEP_BEST mid-window, the official
+# bench/xprof at the NEW winner may still be missing (pool dropped). Poll
+# and bank the leftovers via the campaign itself (probe+bench+profile —
+# per-stage subprocess timeouts, campaign.json manifest, exit 2 = pool
+# down) plus the one unmeasured tile point. Per-step done-flags make every
+# retry skip already-banked steps, and a previously banked bench record is
+# backed up before the campaign can truncate it.
+#
+# Usage: nohup bash tools/rebench_watcher.sh >> perf/rebench_watcher.log 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+ATTEMPTS=${ATTEMPTS:-60}
+SLEEP_S=${SLEEP_S:-240}
+DONE_CAMPAIGN=perf/.rebench_campaign_done
+DONE_TILE=perf/.rebench_tile_done
+for i in $(seq 1 "$ATTEMPTS"); do
+    echo "[rebench] attempt $i/$ATTEMPTS $(date -u +%FT%TZ)"
+    if [ ! -f "$DONE_CAMPAIGN" ]; then
+        if [ -s perf/bench.json ]; then
+            cp perf/bench.json "perf/bench.json.bak$i"
+        fi
+        timeout 7500 python tools/tpu_campaign.py --skip sweep,decode
+        rc=$?
+        echo "[rebench] campaign(probe+bench+profile) rc=$rc"
+        [ "$rc" -eq 0 ] && touch "$DONE_CAMPAIGN"
+        if [ "$rc" -ne 0 ]; then
+            sleep "$SLEEP_S"
+            continue
+        fi
+    fi
+    if [ ! -f "$DONE_TILE" ]; then
+        # outer timeout > the point child's own 600s budget, so the
+        # child's timeout path records the point instead of the parent
+        # dying first; sweep_train exits non-zero when no point measured
+        timeout 800 python tools/sweep_train.py \
+            --points "4,dots_flash,512,2048" >> perf/sweep_tiles.log 2>&1
+        rc=$?
+        echo "[rebench] tile point rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_TILE"
+        else
+            # the campaign step just succeeded, so the pool was UP and the
+            # point still failed (OOM / >600s compile, like 1024x1024 did)
+            # — deterministic, not weather; two strikes and it's pruned
+            # rather than burning ~600s of every future pool window
+            tile_fails=$((tile_fails + 1))
+            if [ "$tile_fails" -ge 2 ]; then
+                echo "[rebench] tile point pruned after $tile_fails pool-up failures"
+                touch "$DONE_TILE"
+            fi
+        fi
+    fi
+    if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_TILE" ]; then
+        echo "[rebench] done $(date -u +%FT%TZ)"
+        exit 0
+    fi
+    sleep "$SLEEP_S"
+done
+echo "[rebench] gave up"
+exit 1

@@ -173,6 +173,16 @@ def main():
                     help="measure every point in this process (no isolation)")
     ap.add_argument("--one", default=None, metavar="MICRO,POLICY,BQ,BK",
                     help="child mode: measure one point and exit")
+    ap.add_argument("--points", default=None,
+                    metavar="MICRO,POLICY,BQ,BK[;...]",
+                    help="measure exactly these points instead of the "
+                         "default grid (SWEEP_BEST still updates if one "
+                         "of them wins)")
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore the committed SWEEP_BEST record: this "
+                         "run's own best wins even if slower (use after a "
+                         "hardware/code change makes the old record "
+                         "unreproducible)")
     args = ap.parse_args()
 
     if args.one:
@@ -183,11 +193,34 @@ def main():
 
     smoke = smoke_mode()
     in_process = args.in_process or smoke  # smoke: child spawn is overhead
-    if in_process:
+    if args.points:
+        # explicit points: no device probe (the children discover the
+        # backend themselves), no --quick/smoke truncation — "exactly
+        # these points" means exactly these points
+        grid = []
+        for spec in filter(None, args.points.split(";")):
+            try:
+                micro, pol, bq, bk = spec.split(",")
+                grid.append((int(micro), pol, (int(bq), int(bk))))
+            except ValueError:
+                raise SystemExit(
+                    f"sweep: bad --points spec {spec!r} "
+                    "(want MICRO,POLICY,BQ,BK)")
+        if not grid:
+            raise SystemExit("sweep: --points named no points")
+        if in_process:
+            tuner, B, S, smoke = build_tuner()
+        else:
+            from bench import bench_dims
+
+            B, S = bench_dims(smoke)
+    elif in_process:
         tuner, B, S, smoke = build_tuner()
         import jax
 
         grid = default_grid(B, max(len(jax.devices()), 1))
+        if args.quick or smoke:
+            grid = grid[:3]
     else:
         # the parent only needs the grid geometry; the model compiles in
         # the children. B/S come from the bench definition without jax.
@@ -195,17 +228,21 @@ def main():
 
         B, S = bench_dims(smoke)
         grid = default_grid(B, device_count_subprocess())
-    if args.quick or smoke:
-        grid = grid[:3]
+        if args.quick:
+            grid = grid[:3]
 
     from deepspeed_tpu.autotuning.autotuner import result_to_config_patch
 
     write = not args.no_write and not smoke
 
-    def save_best(best):
+    def build_out(best):
         out = {"best": best}
         if best is not None:
             out["config_patch"] = result_to_config_patch(best)
+        return out
+
+    def save_best(best):
+        out = build_out(best)
         if best is not None and write:
             # incremental: a stage-level kill (campaign timeout, pool drop)
             # must not discard points already measured
@@ -213,13 +250,28 @@ def main():
                 json.dump(out, f, indent=1)
         return out
 
+    # SWEEP_BEST is a high-water mark: a focused --points run (or a noisy
+    # re-measure of the committed winner) must not replace the record with
+    # a slower point, so the incumbent competes as this run's baseline.
+    # --fresh drops the incumbent when the old record is unreproducible
+    # (hardware/topology/code change).
     best = None
+    if not args.fresh:
+        try:
+            with open(SWEEP_BEST) as f:
+                incumbent = (json.load(f) or {}).get("best") or None
+            if incumbent and incumbent.get("tok_s"):
+                best = incumbent
+        except Exception:
+            pass
+    measured = 0
     for point in grid:
         if in_process:
             [rec] = tuner.measure_grid([point])
         else:
             rec = measure_point_subprocess(point)
         if rec.get("throughput"):
+            measured += 1
             rec = dict(rec, step_s=round(B * S / rec["throughput"], 4),
                        tok_s=round(rec["throughput"], 1))
             if best is None or rec["tok_s"] > best["tok_s"]:
@@ -227,7 +279,15 @@ def main():
                 save_best(best)
         print(json.dumps(rec), flush=True)
 
-    print(json.dumps(save_best(best)))
+    # final line reports the standing record; the file was already written
+    # incrementally on every improvement, so a no-improvement run leaves
+    # SWEEP_BEST untouched (a slower re-measure must not regenerate the
+    # record or strip fields save_best doesn't produce)
+    print(json.dumps(build_out(best)))
+    if not measured:
+        # every point errored/OOMed/timed out — callers (rebench watcher,
+        # campaign) must see this as a failed run, not a quiet no-op
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
