@@ -3,29 +3,69 @@
 # bench/xprof at the NEW winner may still be missing (pool dropped). Poll
 # and bank the leftovers via the campaign itself (probe+bench+profile —
 # per-stage subprocess timeouts, campaign.json manifest, exit 2 = pool
-# down) plus the one unmeasured tile point. Per-step done-flags make every
-# retry skip already-banked steps, and a previously banked bench record is
-# backed up before the campaign can truncate it.
+# down) plus the MoE dispatch A/B and the one unmeasured tile point.
+# Per-step done-flags make every retry skip already-banked steps; a
+# previously banked bench record is backed up before the campaign can
+# truncate it. Steps that fail while the pool is demonstrably up get a
+# two-strike prune (deterministic OOM / >timeout compile, not weather)
+# instead of burning ~600s of every future window.
 #
 # Usage: nohup bash tools/rebench_watcher.sh >> perf/rebench_watcher.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
 ATTEMPTS=${ATTEMPTS:-60}
 SLEEP_S=${SLEEP_S:-240}
 DONE_CAMPAIGN=perf/.rebench_campaign_done
+DONE_MOE=perf/.rebench_moe_done
 DONE_TILE=perf/.rebench_tile_done
+tile_fails=0
+moe_fails=0
+
+pool_up() {
+    timeout 120 python -c \
+        "import jax, jax.numpy as jnp; print('PROBE_OK', float(jnp.ones((8,8)).sum()))" \
+        2>/dev/null | grep -q PROBE_OK
+}
+
 for i in $(seq 1 "$ATTEMPTS"); do
     echo "[rebench] attempt $i/$ATTEMPTS $(date -u +%FT%TZ)"
     if [ ! -f "$DONE_CAMPAIGN" ]; then
         if [ -s perf/bench.json ]; then
             cp perf/bench.json "perf/bench.json.bak$i"
         fi
-        timeout 7500 python tools/tpu_campaign.py --skip sweep,decode
+        # outer guard > worst-case sum of the wrapped stage timeouts
+        # (probe 120 + bench 3600 + profile 3600); moe/tile run as their
+        # own steps below so a failure there can't force these expensive
+        # stages to re-run
+        timeout 7500 python tools/tpu_campaign.py --skip sweep,decode,moe
         rc=$?
         echo "[rebench] campaign(probe+bench+profile) rc=$rc"
-        [ "$rc" -eq 0 ] && touch "$DONE_CAMPAIGN"
         if [ "$rc" -ne 0 ]; then
             sleep "$SLEEP_S"
             continue
+        fi
+        touch "$DONE_CAMPAIGN"
+    elif ! pool_up; then
+        # the remaining steps need the pool; a down-pool failure must not
+        # count toward any prune counter
+        echo "[rebench] pool down; retrying in ${SLEEP_S}s"
+        sleep "$SLEEP_S"
+        continue
+    fi
+    if [ ! -f "$DONE_MOE" ]; then
+        timeout 2500 python tools/bench_moe.py --dispatch einsum \
+            > perf/moe_einsum.json 2>&1 \
+            && timeout 2500 python tools/bench_moe.py --dispatch gather \
+                > perf/moe_gather.json 2>&1
+        rc=$?
+        echo "[rebench] moe A/B rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_MOE"
+        else
+            moe_fails=$((moe_fails + 1))
+            if [ "$moe_fails" -ge 2 ]; then
+                echo "[rebench] moe A/B pruned after $moe_fails pool-up failures"
+                touch "$DONE_MOE"
+            fi
         fi
     fi
     if [ ! -f "$DONE_TILE" ]; then
@@ -39,10 +79,6 @@ for i in $(seq 1 "$ATTEMPTS"); do
         if [ "$rc" -eq 0 ]; then
             touch "$DONE_TILE"
         else
-            # the campaign step just succeeded, so the pool was UP and the
-            # point still failed (OOM / >600s compile, like 1024x1024 did)
-            # — deterministic, not weather; two strikes and it's pruned
-            # rather than burning ~600s of every future pool window
             tile_fails=$((tile_fails + 1))
             if [ "$tile_fails" -ge 2 ]; then
                 echo "[rebench] tile point pruned after $tile_fails pool-up failures"
@@ -50,7 +86,7 @@ for i in $(seq 1 "$ATTEMPTS"); do
             fi
         fi
     fi
-    if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_TILE" ]; then
+    if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE" ] && [ -f "$DONE_TILE" ]; then
         echo "[rebench] done $(date -u +%FT%TZ)"
         exit 0
     fi

@@ -165,6 +165,19 @@ def main():
             ))
             save_manifest()
 
+    # 6. MoE dispatch A/B — einsum (one-hot dots) vs gather (index tables):
+    # a hardware question (MXU vs HBM headroom), answered once per chip
+    if "moe" not in skip:
+        for mode in (["einsum", "gather"][:1] if args.quick
+                     else ["einsum", "gather"]):
+            results.append(run_stage(
+                f"moe[{mode}]",
+                [PY, "tools/bench_moe.py", "--dispatch", mode],
+                os.path.join(PERF, f"moe_{mode}.json"),
+                timeout=2400,
+            ))
+            save_manifest()
+
     bad = [r for r in results if r["rc"] != 0]
     print(f"[campaign] done: {len(results) - len(bad)}/{len(results)} stages "
           f"ok; artifacts in {PERF}", flush=True)
