@@ -109,6 +109,9 @@ def load_sweep_seed(dp: int, B: int):
         if rec.get("flash_block_q") or rec.get("flash_block_k"):
             tk = {"flash_block_q": int(rec.get("flash_block_q", 0)),
                   "flash_block_k": int(rec.get("flash_block_k", 0))}
+        if rec.get("flash_block_q_bwd") or rec.get("flash_block_k_bwd"):
+            tk["flash_block_q_bwd"] = int(rec.get("flash_block_q_bwd", 0))
+            tk["flash_block_k_bwd"] = int(rec.get("flash_block_k_bwd", 0))
         return (pol, micro, tk)
     except Exception:
         return None

@@ -23,7 +23,10 @@ import numpy as np
 from ..utils.logging import log_dist
 
 REMAT_POLICIES = ("none", "dots_flash", "attn_mlp", "full")
-FLASH_BLOCKS = ((0, 0), (512, 512), (512, 256), (256, 512), (128, 128))
+# (512, 512) is NOT a candidate: it equals the kernel defaults (see
+# flash_attention.DEFAULT_BLOCK_*) so phase 2 would re-measure the (0, 0)
+# phase-1 winner; 512x1024 is the measured v5e S=2048 winner
+FLASH_BLOCKS = ((0, 0), (512, 1024), (512, 256), (256, 512), (128, 128))
 
 
 def _is_oom(err: Exception) -> bool:
@@ -94,9 +97,15 @@ class Autotuner:
             accum = int(cfg.get("gradient_accumulation_steps", 1))
             cfg["train_batch_size"] = micro_batch * dp * accum
         cfg["activation_checkpointing"] = {"policy": remat}
-        if blocks != (0, 0):
+        blocks = tuple(blocks) + (0,) * (4 - len(blocks))  # (bq,bk[,bqb,bkb])
+        if any(blocks):
             tk = dict(cfg.get("tpu_kernels") or {})
-            tk["flash_block_q"], tk["flash_block_k"] = blocks
+            # bwd keys assigned unconditionally: a candidate's 0 means
+            # "inherit the fwd tile" and must overwrite any stale bwd
+            # override inherited from the base config, or the record would
+            # claim tiles the measurement didn't run with
+            tk["flash_block_q"], tk["flash_block_k"] = blocks[:2]
+            tk["flash_block_q_bwd"], tk["flash_block_k_bwd"] = blocks[2:]
             cfg["tpu_kernels"] = tk
         cfg.setdefault("steps_per_print", 10**9)
         engine = None
@@ -143,6 +152,9 @@ class Autotuner:
                 "micro_batch": int(micro), "remat_policy": pol,
                 "flash_block_q": int(blocks[0]), "flash_block_k": int(blocks[1]),
             }
+            if len(blocks) > 2 and (blocks[2] or blocks[3]):
+                rec["flash_block_q_bwd"] = int(blocks[2])
+                rec["flash_block_k_bwd"] = int(blocks[3])
             try:
                 rec["throughput"] = self._measure(micro, pol, tuple(blocks))
             except Exception as e:  # noqa: BLE001
@@ -223,6 +235,12 @@ def result_to_config_patch(rec: Dict[str, Any]) -> Dict[str, Any]:
     if bq or bk:
         patch["tpu_kernels"] = {"flash_block_q": int(bq),
                                 "flash_block_k": int(bk)}
+    bqb = rec.get("flash_block_q_bwd", 0)
+    bkb = rec.get("flash_block_k_bwd", 0)
+    if bqb or bkb:
+        patch.setdefault("tpu_kernels", {}).update(
+            flash_block_q_bwd=int(bqb), flash_block_k_bwd=int(bkb)
+        )
     return patch
 
 

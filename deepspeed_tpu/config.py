@@ -362,6 +362,8 @@ class TpuKernelsConfig:
     fused_adam: Any = False  # optax update already fuses into the step
     flash_block_q: int = 0  # 0 => kernel default
     flash_block_k: int = 0
+    flash_block_q_bwd: int = 0  # 0 => inherit the fwd tile (dq/dkv kernels)
+    flash_block_k_bwd: int = 0
     # vocab-chunked cross-entropy (ops/cross_entropy.py): the [B,S,V] logit
     # tensor never materializes. auto => on for TPU (tp=1 meshes only; the
     # vocab-parallel dense path handles tp>1)
@@ -378,6 +380,8 @@ class TpuKernelsConfig:
             fused_adam=res(self.fused_adam),
             flash_block_q=int(self.flash_block_q),
             flash_block_k=int(self.flash_block_k),
+            flash_block_q_bwd=int(self.flash_block_q_bwd),
+            flash_block_k_bwd=int(self.flash_block_k_bwd),
             fused_ce=res(self.fused_ce),
             ce_chunk=int(self.ce_chunk),
         )

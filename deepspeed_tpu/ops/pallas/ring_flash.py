@@ -35,19 +35,27 @@ from .flash_attention import (
     _flash_fwd,
     _pick_block,
     current_block_sizes,
+    current_bwd_block_sizes,
 )
 
 
 def ring_blocks(S_loc: int):
-    """(block_q, block_k) for the local chunk, or None when ineligible.
+    """(block_q, block_k, block_q_bwd, block_k_bwd) for the local chunk, or
+    None when ineligible.
 
-    Resolves through current_block_sizes() so scoped/tuned tile overrides
-    (engine tpu_kernels.flash_block_*, autotuner winners) apply on the
-    ring path exactly as on the flat path."""
+    Resolves through current_block_sizes()/current_bwd_block_sizes() so
+    scoped/tuned tile overrides (engine tpu_kernels.flash_block_*,
+    autotuner winners) apply on the ring path exactly as on the flat path;
+    unset bwd tiles inherit the resolved fwd ones."""
     pref_q, pref_k = current_block_sizes()
     bq = _pick_block(S_loc, pref_q)
     bk = _pick_block(S_loc, pref_k)
-    return (bq, bk) if bq and bk else None
+    if not (bq and bk):
+        return None
+    pref_qb, pref_kb = current_bwd_block_sizes()
+    bqb = (_pick_block(S_loc, pref_qb) if pref_qb else None) or bq
+    bkb = (_pick_block(S_loc, pref_kb) if pref_kb else None) or bk
+    return (bq, bk, bqb, bkb)
 
 
 def _offsets(i, blk, S_loc):
@@ -62,16 +70,16 @@ def _seg_arg(seg_q, seg_k):
     return (seg_q, seg_k) if seg_q is not None else None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
 def _ring_flash_bhsd(q, k, v, seg_q, seg_k, slopes, causal, axis, block_q,
-                     block_k, interpret):
+                     block_k, block_q_bwd, block_k_bwd, interpret):
     out, _ = _rf_fwd(q, k, v, seg_q, seg_k, slopes, causal, axis, block_q,
-                     block_k, interpret)
+                     block_k, block_q_bwd, block_k_bwd, interpret)
     return out
 
 
 def _rf_fwd(q, k, v, seg_q, seg_k, slopes, causal, axis, block_q, block_k,
-            interpret):
+            block_q_bwd, block_k_bwd, interpret):
     sp = lax.axis_size(axis)
     i = lax.axis_index(axis)
     B, H, S_loc, D = q.shape
@@ -107,7 +115,8 @@ def _rf_fwd(q, k, v, seg_q, seg_k, slopes, causal, axis, block_q, block_k,
     return out, (q, k, v, seg_q, seg_k, slopes, out, lse_acc)
 
 
-def _rf_bwd(causal, axis, block_q, block_k, interpret, res, do):
+def _rf_bwd(causal, axis, block_q, block_k, block_q_bwd, block_k_bwd,
+            interpret, res, do):
     q, k, v, seg_q, seg_k, slopes, out, lse = res
     sp = lax.axis_size(axis)
     i = lax.axis_index(axis)
@@ -131,7 +140,7 @@ def _rf_bwd(causal, axis, block_q, block_k, interpret, res, do):
         dq_s, dk_s, dv_s, _ = _flash_bwd(
             q, kb, vb, None, lse_b, do, None, _seg_arg(seg_q, segb), slopes,
             None, _offsets(i, blk, S_loc), causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k, interpret=interpret,
+            block_q=block_q_bwd, block_k=block_k_bwd, interpret=interpret,
             delta=delta_b,
         )
         dq_acc = dq_acc + dq_s.astype(jnp.float32)
@@ -165,8 +174,8 @@ _ring_flash_bhsd.defvjp(_rf_fwd, _rf_bwd)
 
 
 def ring_flash_attention_local(q, k, v, seg_q, seg_k, slopes, *, causal,
-                               axis, block_q, block_k,
-                               interpret=None):
+                               axis, block_q, block_k, block_q_bwd=0,
+                               block_k_bwd=0, interpret=None):
     """Model layout entry ([B, S_loc, H|KV, D]), inside the ring shard_map."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -175,6 +184,6 @@ def ring_flash_attention_local(q, k, v, seg_q, seg_k, slopes, *, causal,
     vt = jnp.swapaxes(v, 1, 2)
     out = _ring_flash_bhsd(
         qt, kt, vt, seg_q, seg_k, slopes, causal, axis, block_q, block_k,
-        interpret,
+        block_q_bwd or block_q, block_k_bwd or block_k, interpret,
     )
     return jnp.swapaxes(out, 1, 2)

@@ -249,6 +249,7 @@ def ring_attention(q, k, v, *, causal=True, segment_ids=None,
                 sl if has_alibi else None,
                 causal=causal, axis=axis,
                 block_q=blocks[0], block_k=blocks[1],
+                block_q_bwd=blocks[2], block_k_bwd=blocks[3],
             )
         return _ring_attention_local(
             ql, kl, vl, segl, segl if has_seg else None,

@@ -537,7 +537,8 @@ class TpuEngine:
         )
         stack.enter_context(pallas_rmsnorm_scope(tk.fused_rmsnorm))
         stack.enter_context(
-            block_sizes_scope(tk.flash_block_q, tk.flash_block_k)
+            block_sizes_scope(tk.flash_block_q, tk.flash_block_k,
+                              tk.flash_block_q_bwd, tk.flash_block_k_bwd)
         )
         from ..ops.cross_entropy import fused_ce_scope
 

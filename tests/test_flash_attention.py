@@ -512,3 +512,52 @@ def test_causal_dma_skip_bitmatches_dense_grid(monkeypatch):
         a, k, v, causal=True, block_q=128, block_k=128) ** 2))(q)
     np.testing.assert_array_equal(np.asarray(out_skip), np.asarray(out_dense))
     np.testing.assert_array_equal(np.asarray(g_skip), np.asarray(g_dense))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_bwd_tiles_independent_of_fwd_tiles(causal):
+    """dq/dkv kernels accept their own tile sizes (the causal DMA-skip
+    tables are rebuilt at bwd granularity): grads must be identical to the
+    symmetric-tile run."""
+    q, k, v = _qkv(jax.random.PRNGKey(22), B=1, S=256, H=2, D=64)
+
+    def loss(bqb, bkb):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=128, block_k=256,
+                block_q_bwd=bqb, block_k_bwd=bkb) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    base = loss(0, 0)           # inherit fwd tiles (128, 256)
+    asym = loss(256, 128)       # bwd q-tile 2x fwd, bwd k-tile HALF fwd —
+    # both directions of the causal-table rebuild covered
+    for g0, g1 in zip(base, asym):
+        np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                                   atol=2e-5)
+
+
+def test_bwd_tiles_scope_and_config():
+    """The scoped override carries the bwd pair, and a user block_mask pins
+    bwd tiles to the layout granularity (grads still match the masked
+    reference)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import block_sizes_scope
+
+    q, k, v = _qkv(jax.random.PRNGKey(23), B=1, S=256, H=2, D=64)
+
+    def g(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    base = jax.grad(g)(q, k, v)
+    with block_sizes_scope(128, 128, 256, 128):
+        scoped = jax.grad(g)(q, k, v)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(scoped),
+                               atol=2e-5)
+
+    # block_mask path: bwd tiles silently pinned to the mask granularity
+    mask = np.tril(np.ones((2, 2), np.int32))
+    def gm(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, block_mask=mask,
+            block_q=128, block_k=128, block_q_bwd=64, block_k_bwd=64) ** 2)
+    out = jax.grad(gm)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=2e-5)

@@ -96,3 +96,25 @@ def test_small_chunks_keep_dense_ring():
     got = jax.jit(lambda a: ring_flash(a, a, a, topo, causal=True))(q)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_ring_flash_bwd_tiles_scope():
+    """Scoped bwd tile overrides reach the ring path's dq/dkv kernels:
+    sp=2 gives S_loc=256, so fwd tiles pinned at 128 and bwd tiles at 256
+    genuinely differ — grads must match the default-tile run."""
+    from deepspeed_tpu.ops.pallas.flash_attention import block_sizes_scope
+
+    q, k, v = rand_qkv(seed=7)
+    topo = MeshTopology(dims=ParallelDims(sp=2, dp=4))
+
+    def loss(q, k, v):
+        return jnp.sum(ring_flash(q, k, v, topo, causal=True) ** 2)
+
+    g_base = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    with block_sizes_scope(128, 128, 256, 256):
+        g_scoped = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for gb, gs, name in zip(g_base, g_scoped, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gb), np.asarray(gs), rtol=5e-4, atol=5e-4,
+            err_msg=f"d{name}",
+        )

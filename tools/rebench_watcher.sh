@@ -15,10 +15,12 @@ cd "$(dirname "$0")/.." || exit 1
 ATTEMPTS=${ATTEMPTS:-60}
 SLEEP_S=${SLEEP_S:-240}
 DONE_CAMPAIGN=perf/.rebench_campaign_done
-DONE_MOE=perf/.rebench_moe_done
+DONE_MOE_E=perf/.rebench_moe_einsum_done
+DONE_MOE_G=perf/.rebench_moe_gather_done
 DONE_TILE=perf/.rebench_tile_done
 tile_fails=0
-moe_fails=0
+moe_e_fails=0
+moe_g_fails=0
 
 pool_up() {
     timeout 120 python -c \
@@ -51,29 +53,41 @@ for i in $(seq 1 "$ATTEMPTS"); do
         sleep "$SLEEP_S"
         continue
     fi
-    if [ ! -f "$DONE_MOE" ]; then
+    # MoE A/B: one flag per dispatch leg so a gather-only failure never
+    # re-burns the banked einsum measurement
+    if [ ! -f "$DONE_MOE_E" ]; then
         timeout 2500 python tools/bench_moe.py --dispatch einsum \
-            > perf/moe_einsum.json 2>&1 \
-            && timeout 2500 python tools/bench_moe.py --dispatch gather \
-                > perf/moe_gather.json 2>&1
+            > perf/moe_einsum.json 2>&1
         rc=$?
-        echo "[rebench] moe A/B rc=$rc"
+        echo "[rebench] moe einsum rc=$rc"
         if [ "$rc" -eq 0 ]; then
-            touch "$DONE_MOE"
+            touch "$DONE_MOE_E"
         else
-            moe_fails=$((moe_fails + 1))
-            if [ "$moe_fails" -ge 2 ]; then
-                echo "[rebench] moe A/B pruned after $moe_fails pool-up failures"
-                touch "$DONE_MOE"
-            fi
+            moe_e_fails=$((moe_e_fails + 1))
+            [ "$moe_e_fails" -ge 2 ] \
+                && echo "[rebench] moe einsum pruned" && touch "$DONE_MOE_E"
+        fi
+    fi
+    if [ ! -f "$DONE_MOE_G" ]; then
+        timeout 2500 python tools/bench_moe.py --dispatch gather \
+            > perf/moe_gather.json 2>&1
+        rc=$?
+        echo "[rebench] moe gather rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_MOE_G"
+        else
+            moe_g_fails=$((moe_g_fails + 1))
+            [ "$moe_g_fails" -ge 2 ] \
+                && echo "[rebench] moe gather pruned" && touch "$DONE_MOE_G"
         fi
     fi
     if [ ! -f "$DONE_TILE" ]; then
         # outer timeout > the point child's own 600s budget, so the
         # child's timeout path records the point instead of the parent
         # dying first; sweep_train exits non-zero when no point measured
-        timeout 800 python tools/sweep_train.py \
-            --points "4,dots_flash,512,2048" >> perf/sweep_tiles.log 2>&1
+        timeout 2600 python tools/sweep_train.py \
+            --points "4,dots_flash,512,2048;4,dots_flash,512,1024,256,512;4,dots_flash,512,1024,512,512" \
+            >> perf/sweep_tiles.log 2>&1
         rc=$?
         echo "[rebench] tile point rc=$rc"
         if [ "$rc" -eq 0 ]; then
@@ -86,7 +100,8 @@ for i in $(seq 1 "$ATTEMPTS"); do
             fi
         fi
     fi
-    if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE" ] && [ -f "$DONE_TILE" ]; then
+    if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE_E" ] \
+        && [ -f "$DONE_MOE_G" ] && [ -f "$DONE_TILE" ]; then
         echo "[rebench] done $(date -u +%FT%TZ)"
         exit 0
     fi

@@ -111,7 +111,10 @@ def default_grid(B, dp):
     mb_full = max(B // dp, 1)
     micros = [mb_full, max(mb_full // 2, 1)]
     policies = ["none", "dots_flash", "dots_saveable"]
-    tiles = [(0, 0), (512, 512)]
+    # (0,0) = kernel defaults (512x512 as of the v5e tile measurement);
+    # 512x1024 is the measured S=2048 winner; 256x256 guards against a
+    # shape where the bigger defaults regress
+    tiles = [(0, 0), (512, 1024), (256, 256)]
     grid = list(itertools.product(micros, policies, tiles))
     # the committed winner's neighborhood measures FIRST: the pool drops
     # without warning, and the incremental SWEEP_BEST write means a partial
@@ -131,21 +134,36 @@ def default_grid(B, dp):
     return grid
 
 
+def parse_point(spec: str):
+    """MICRO,POLICY,BQ,BK[,BQ_BWD,BK_BWD] → (micro, policy, blocks)."""
+    parts = spec.split(",")
+    if len(parts) not in (4, 6):
+        raise SystemExit(
+            f"sweep: bad point spec {spec!r} "
+            "(want MICRO,POLICY,BQ,BK[,BQ_BWD,BK_BWD])")
+    try:
+        return (int(parts[0]), parts[1], tuple(int(x) for x in parts[2:]))
+    except ValueError:
+        raise SystemExit(f"sweep: non-integer field in point spec {spec!r}")
+
+
 def run_one(point_csv: str) -> None:
-    """Child mode: measure exactly one (micro, policy, bq, bk) point and
-    print its record as the final JSON line."""
-    micro, pol, bq, bk = point_csv.split(",")
+    """Child mode: measure exactly one point and print its record as the
+    final JSON line."""
     tuner, _, _, _ = build_tuner()
-    [rec] = tuner.measure_grid([(int(micro), pol, (int(bq), int(bk)))])
+    [rec] = tuner.measure_grid([parse_point(point_csv)])
     print("SWEEP_POINT " + json.dumps(rec), flush=True)
 
 
 def measure_point_subprocess(point):
-    micro, pol, (bq, bk) = point
-    cmd = [sys.executable, os.path.abspath(__file__),
-           "--one", f"{micro},{pol},{bq},{bk}"]
+    micro, pol, blocks = point
+    csv = ",".join([str(micro), pol, *map(str, blocks)])
+    cmd = [sys.executable, os.path.abspath(__file__), "--one", csv]
     rec = {"micro_batch": int(micro), "remat_policy": pol,
-           "flash_block_q": int(bq), "flash_block_k": int(bk)}
+           "flash_block_q": int(blocks[0]), "flash_block_k": int(blocks[1])}
+    if len(blocks) > 2 and (blocks[2] or blocks[3]):
+        rec["flash_block_q_bwd"] = int(blocks[2])
+        rec["flash_block_k_bwd"] = int(blocks[3])
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, cwd=REPO_DIR,
@@ -197,15 +215,8 @@ def main():
         # explicit points: no device probe (the children discover the
         # backend themselves), no --quick/smoke truncation — "exactly
         # these points" means exactly these points
-        grid = []
-        for spec in filter(None, args.points.split(";")):
-            try:
-                micro, pol, bq, bk = spec.split(",")
-                grid.append((int(micro), pol, (int(bq), int(bk))))
-            except ValueError:
-                raise SystemExit(
-                    f"sweep: bad --points spec {spec!r} "
-                    "(want MICRO,POLICY,BQ,BK)")
+        grid = [parse_point(spec)
+                for spec in filter(None, args.points.split(";"))]
         if not grid:
             raise SystemExit("sweep: --points named no points")
         if in_process:
