@@ -31,7 +31,8 @@ import contextlib
 from ..comm.topology import MeshTopology, ParallelDims
 from ..models.decoding import forward_with_cache, init_cache
 from ..models.sharding import use_topology
-from ..ops.quantizer import quantize_dequantize
+from ..ops.quantizer import (materialize_packed, pack_quantize_blockwise,
+                             quantize_dequantize)
 from ..utils.logging import log_dist
 
 
@@ -206,12 +207,24 @@ class InferenceEngine:
         )
 
     def _quantize_weights(self, params, bits: int):
-        """Weight-only block quantization of the big matmul weights."""
+        """Weight-only block quantization of the big matmul weights.
+
+        Single-device: PACKED storage (ops/quantizer.PackedWeight) — HBM
+        holds int8/int4 + scales and the decode loop streams that, with
+        the dequant materialized inside the loop body (materialize_packed)
+        so XLA fuses it into the consuming matmuls instead of hoisting a
+        full-width weight copy. Under tp>1 the partition_specs tree maps
+        one spec per original leaf and cannot shard the packed pair, so
+        the fake-quant roundtrip keeps the old behavior there (numerics
+        identical either way — same q/dq values)."""
         big = {"wq", "wk", "wv", "wo", "wi", "wg"}
+        packed = self.topology.world_size == 1
 
         def q(path, leaf):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
             if name in big and leaf.ndim >= 2:
+                if packed:
+                    return pack_quantize_blockwise(leaf, block=128, bits=bits)
                 return quantize_dequantize(leaf, block=128, bits=bits)
             return leaf
 
@@ -222,7 +235,9 @@ class InferenceEngine:
         """Plain logits forward (no cache) — reference engine __call__."""
         if not hasattr(self, "_jit_forward"):  # jit once, not per call
             self._jit_forward = jax.jit(
-                lambda p, ids: self.model.apply(p, ids, dtype=self.dtype)
+                lambda p, ids: self.model.apply(
+                    materialize_packed(p, self.dtype), ids, dtype=self.dtype
+                )
             )
         with use_topology(self.topology), self._impl_ctx():
             logits, _ = self._jit_forward(self.params, jnp.asarray(input_ids))
@@ -260,7 +275,8 @@ class InferenceEngine:
             draft_cache = init_cache(dcfg, 1, total_alloc, self.dtype)
             prompt = tokens_buf[:, :prompt_len]
             logits, main_cache = forward_with_cache(
-                cfg, params, prompt, main_cache, 0, dtype=self.dtype
+                cfg, materialize_packed(params, self.dtype), prompt,
+                main_cache, 0, dtype=self.dtype
             )
             n0 = jnp.argmax(logits[:, -1], axis=-1)  # token at position P
             tokens_buf = lax.dynamic_update_slice(
@@ -301,8 +317,10 @@ class InferenceEngine:
                 )
                 cand = cand[:, :k]  # the k-th drafted token is never proposed
                 # --- verify the whole window in one main forward --------
+                # in-body materialize: keeps the dequant inside the loop
                 vlog, main_cache = forward_with_cache(
-                    cfg, params, cand, main_cache, pos, dtype=self.dtype
+                    cfg, materialize_packed(params, self.dtype), cand,
+                    main_cache, pos, dtype=self.dtype
                 )
                 targets = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [1,k]
                 # longest matching prefix of drafted vs verifier tokens
@@ -351,7 +369,8 @@ class InferenceEngine:
             )
             prompt = tokens_buf[:, :prompt_len]
             logits, cache = forward_with_cache(
-                cfg, params, prompt, cache, 0, dtype=self.dtype
+                cfg, materialize_packed(params, self.dtype), prompt, cache,
+                0, dtype=self.dtype
             )
             return logits[:, -1], cache
 
@@ -414,8 +433,12 @@ class InferenceEngine:
             def body(state):
                 tokens_buf, cache, pos, rng, done, seen = state
                 tok = lax.dynamic_slice(tokens_buf, (0, pos), (B, 1))
+                # materialize INSIDE the loop body: the int8->bf16 convert
+                # is size-inflating, so XLA's while-loop LICM keeps it here
+                # and the loop streams quantized weights from HBM
                 logits, cache = forward_with_cache(
-                    self.config, params, tok, cache, pos, dtype=self.dtype
+                    self.config, materialize_packed(params, self.dtype),
+                    tok, cache, pos, dtype=self.dtype
                 )
                 key, rng = jax.random.split(rng)
                 nxt = step_sample(logits[:, -1], seen, key)

@@ -549,3 +549,67 @@ def test_speculative_full_acceptance_round_count():
         assert spec.last_spec_rounds == math.ceil((new - 1) / k), (
             nd, spec.last_spec_rounds
         )
+
+
+def test_packed_int8_storage_and_token_parity():
+    """Single-device int8 serving stores PACKED weights (int8 qdata lives
+    in the params tree — the HBM stream the decode loop reads) and decodes
+    the same tokens as the fake-quant roundtrip (identical q/dq values by
+    construction)."""
+    from deepspeed_tpu.ops.quantizer import PackedWeight, quantize_dequantize
+
+    model = tiny_llama(hidden_size=64, intermediate_size=128)
+    topo = MeshTopology(devices=jax.devices()[:1])
+    eng_q = init_inference(model, dtype=jnp.float32, quantize_bits=8,
+                           rng=jax.random.PRNGKey(7), topology=topo,
+                           max_tokens=24)
+    packed = [
+        leaf for leaf in jax.tree_util.tree_leaves(
+            eng_q.params,
+            is_leaf=lambda x: isinstance(x, PackedWeight))
+        if isinstance(leaf, PackedWeight)
+    ]
+    assert packed, "no PackedWeight leaves — int8 storage is not packed"
+    assert all(leaf.qdata.dtype == jnp.int8 for leaf in packed)
+
+    # reference: same weights through the fake-quant roundtrip (the same
+    # name rule _quantize_weights uses)
+    big = {"wq", "wk", "wv", "wo", "wi", "wg"}
+
+    def fake_q(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in big and leaf.ndim >= 2:
+            return quantize_dequantize(leaf, block=128, bits=8)
+        return leaf
+
+    ref_params = jax.tree_util.tree_map_with_path(
+        fake_q, model.init(jax.random.PRNGKey(7), dtype=jnp.float32)
+    )
+    eng_ref = init_inference(model, dtype=jnp.float32, params=ref_params,
+                             topology=topo, max_tokens=24)
+    ids = np.random.RandomState(7).randint(0, 128, size=(1, 8))
+    out_q = np.asarray(eng_q.generate(ids, max_new_tokens=8, temperature=0.0))
+    out_r = np.asarray(eng_ref.generate(ids, max_new_tokens=8,
+                                        temperature=0.0))
+    np.testing.assert_array_equal(out_q, out_r)
+
+
+@pytest.mark.parametrize("cols", [16, 15])
+def test_int4_nibble_packing_roundtrip(cols):
+    """int4 packed storage nibble-packs two values per byte (even columns:
+    half the int8 bytes) and dequantizes bit-identically to the unpacked
+    quantizer; odd columns fall back to one value per byte."""
+    from deepspeed_tpu.ops.quantizer import (
+        dequantize_blockwise, pack_quantize_blockwise, quantize_blockwise,
+    )
+
+    w = jnp.asarray(np.random.RandomState(11).randn(32, cols), jnp.float32)
+    pw = pack_quantize_blockwise(w, block=16, bits=4)
+    ref = dequantize_blockwise(quantize_blockwise(w, block=16, bits=4),
+                               jnp.float32)
+    np.testing.assert_array_equal(np.asarray(pw.dequantize()),
+                                  np.asarray(ref))
+    if cols % 2 == 0:
+        assert pw.nibbles and pw.qdata.shape[-1] == cols // 2
+    else:
+        assert not pw.nibbles and pw.qdata.shape[-1] == cols
