@@ -36,6 +36,15 @@ from ..ops.quantizer import (materialize_packed, pack_quantize_blockwise,
 from ..utils.logging import log_dist
 
 
+def _align_cache(n: int, mult: int = 128) -> int:
+    """KV-cache capacity rounded up so the Pallas decode kernel always has
+    an aligned block divisor (a 132-row cache has none and silently fell
+    back to the XLA path — observed in the r4 decode bench logs). Capacity
+    padding rows are position-masked by cache_len, so results are
+    unchanged; the cost is a few KB of HBM per layer."""
+    return max(-(-n // mult) * mult, mult)
+
+
 def apply_repetition_penalty(logits, seen, penalty):
     """HF-convention repetition penalty: for tokens in ``seen`` [B, V],
     positive logits divide by the penalty, negative multiply."""
@@ -269,10 +278,12 @@ class InferenceEngine:
 
         def spec_generate(params, dparams, tokens_buf, eos_id):
             main_cache = init_cache(
-                cfg, 1, total_alloc, self.kv_cache_storage_dtype,
+                cfg, 1, _align_cache(total_alloc),
+                self.kv_cache_storage_dtype,
                 quantized=self.kv_cache_quantized,
             )
-            draft_cache = init_cache(dcfg, 1, total_alloc, self.dtype)
+            draft_cache = init_cache(dcfg, 1, _align_cache(total_alloc),
+                                     self.dtype)
             prompt = tokens_buf[:, :prompt_len]
             logits, main_cache = forward_with_cache(
                 cfg, materialize_packed(params, self.dtype), prompt,
@@ -364,7 +375,7 @@ class InferenceEngine:
 
         def prefill(params, tokens_buf):
             cache = init_cache(
-                cfg, B, total_len, self.kv_cache_storage_dtype,
+                cfg, B, _align_cache(total_len), self.kv_cache_storage_dtype,
                 quantized=self.kv_cache_quantized,
             )
             prompt = tokens_buf[:, :prompt_len]
