@@ -156,6 +156,11 @@ def main():
     )
     if seed is not None:
         ladder = [seed] + [r for r in ladder if r[:2] != seed[:2]]
+    if os.environ.get("BENCH_FUSED_ADAM"):
+        # A/B knob for the optimizer elementwise tail (xprof r4: optax
+        # update + clip ≈ 5% of step): same ladder, Pallas fused adam on
+        ladder = [(pol, mb, {**tk, "fused_adam": True})
+                  for pol, mb, tk in ladder]
     engine = None
     last_err = None
     for pol, micro, tk in ladder:
@@ -177,7 +182,7 @@ def main():
             engine.train_batch(batch=data)  # compile
             policy = f"{pol}@mb{micro}" + (
                 "" if tk.get("fused_ce", True) else "+safe"
-            )
+            ) + ("+fadam" if tk.get("fused_adam") else "")
             break
         except Exception as e:  # noqa: BLE001 — any rung failure, try the next:
             # a missing BENCH record costs more than a degraded one; the

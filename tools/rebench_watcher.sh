@@ -19,10 +19,12 @@ DONE_MOE_E=perf/.rebench_moe_einsum_done
 DONE_MOE_G=perf/.rebench_moe_gather_done
 DONE_TILE=perf/.rebench_tile_done
 DONE_INT8=perf/.rebench_decode_int8_done
+DONE_FADAM=perf/.rebench_fused_adam_done
 tile_fails=0
 moe_e_fails=0
 moe_g_fails=0
 int8_fails=0
+fadam_fails=0
 
 pool_up() {
     timeout 120 python -c \
@@ -37,10 +39,10 @@ for i in $(seq 1 "$ATTEMPTS"); do
             cp perf/bench.json "perf/bench.json.bak$i"
         fi
         # outer guard > worst-case sum of the wrapped stage timeouts
-        # (probe 120 + bench 3600 + profile 3600); moe/tile run as their
-        # own steps below so a failure there can't force these expensive
-        # stages to re-run
-        timeout 7500 python tools/tpu_campaign.py --skip sweep,decode,moe
+        # (probe 120 + bench 3600 + profile 3600 + report 300); moe/tile
+        # run as their own steps below so a failure there can't force
+        # these expensive stages to re-run
+        timeout 8100 python tools/tpu_campaign.py --skip sweep,decode,moe
         rc=$?
         echo "[rebench] campaign(probe+bench+profile) rc=$rc"
         if [ "$rc" -ne 0 ]; then
@@ -83,6 +85,21 @@ for i in $(seq 1 "$ATTEMPTS"); do
                 && echo "[rebench] moe gather pruned" && touch "$DONE_MOE_G"
         fi
     fi
+    # fused-adam A/B: xprof r4 put the optax update + clip tail at ~5% of
+    # step; same bench ladder with the Pallas fused adam swapped in
+    if [ ! -f "$DONE_FADAM" ]; then
+        BENCH_FUSED_ADAM=1 timeout 1200 python bench.py \
+            > perf/bench_fused_adam.json 2>&1
+        rc=$?
+        echo "[rebench] bench fused-adam rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_FADAM"
+        else
+            fadam_fails=$((fadam_fails + 1))
+            [ "$fadam_fails" -ge 2 ] \
+                && echo "[rebench] fused-adam bench pruned" && touch "$DONE_FADAM"
+        fi
+    fi
     # packed int8 weight serving (quantizer.PackedWeight): the r4 fake-quant
     # int8 measured 833 tok/s vs bf16's 864 because HBM still streamed bf16;
     # packed storage should flip the sign of that comparison
@@ -120,7 +137,7 @@ for i in $(seq 1 "$ATTEMPTS"); do
     fi
     if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE_E" ] \
         && [ -f "$DONE_MOE_G" ] && [ -f "$DONE_INT8" ] \
-        && [ -f "$DONE_TILE" ]; then
+        && [ -f "$DONE_FADAM" ] && [ -f "$DONE_TILE" ]; then
         echo "[rebench] done $(date -u +%FT%TZ)"
         exit 0
     fi

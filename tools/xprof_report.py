@@ -1,0 +1,122 @@
+"""Self-time op table from an xprof trace — the MFU-gap localizer.
+
+``engine.profile_step()`` (campaign stage 3) writes a TensorBoard-format
+trace; this turns its trace-viewer JSON into the table that actually
+drives optimization: per-op SELF time (nested while/scan bodies double-
+count in the raw events), aggregated by op base name, with HLO category
+and source attribution. The r4 flash-tile and dots_flash wins came
+straight off this table (see PERF_NOTES.md).
+
+Usage:  python tools/xprof_report.py [trace_dir] [--top N] [--out FILE]
+        trace_dir defaults to perf/xprof_trace (latest run inside).
+Writes markdown to --out (default perf/xprof_report.md) and prints it.
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_trace(trace_dir: str) -> str:
+    pats = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.trace.json.gz")
+    ))
+    if not pats:
+        raise SystemExit(f"xprof_report: no *.trace.json.gz under {trace_dir}")
+    return pats[-1]  # latest run dir sorts last (timestamped names)
+
+
+def self_times(path: str):
+    """Per-event self time on the XLA Ops line (dur minus nested children)."""
+    with gzip.open(path) as f:
+        events = json.load(f).get("traceEvents", [])
+    tids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tids[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+    ops_tids = {k for k, v in tids.items() if v == "XLA Ops"}
+    if not ops_tids:
+        raise SystemExit("xprof_report: trace has no 'XLA Ops' thread")
+    # one 'XLA Ops' line per device on a multi-device trace: the nesting
+    # stack is per-timeline, the aggregation sums across all of them
+    self_us, sample = collections.Counter(), {}
+    for tid in ops_tids:
+        ops = [e for e in events
+               if (e.get("pid"), e.get("tid")) == tid and e.get("ph") == "X"]
+        ops.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in ops:
+            ts, dur, name = e["ts"], e["dur"], e["name"]
+            while stack and ts >= stack[-1][0] + stack[-1][1]:
+                stack.pop()
+            if stack:
+                self_us[stack[-1][2]] -= dur
+            self_us[name] += dur
+            sample.setdefault(name, e.get("args", {}))
+            stack.append((ts, dur, name))
+    return self_us, sample, len(ops_tids)
+
+
+def base(name: str) -> str:
+    return re.sub(r"\.\d+(\.clone)?$", "", name)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir", nargs="?",
+                    default=os.path.join(REPO, "perf", "xprof_trace"))
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "perf", "xprof_report.md"))
+    args = ap.parse_args()
+
+    path = find_trace(args.trace_dir)
+    self_us, sample, n_devices = self_times(path)
+    total = sum(self_us.values())
+    if total <= 0:
+        raise SystemExit("xprof_report: empty op timeline")
+
+    agg = collections.Counter()
+    rep: dict = {}
+    for name, us in self_us.items():
+        b = base(name)
+        agg[b] += us
+        if b not in rep or self_us[rep[b]] < us:
+            rep[b] = name
+
+    lines = [
+        f"# xprof self-time report",
+        "",
+        f"trace: `{os.path.relpath(path, REPO)}`  ",
+        f"total device self-time: **{total / 1e3:.1f} ms** "
+        f"(summed over {n_devices} device timeline"
+        f"{'s' if n_devices != 1 else ''})",
+        "",
+        "| ms | % | op | category | source |",
+        "|---:|---:|---|---|---|",
+    ]
+    for b, us in agg.most_common(args.top):
+        a = sample.get(rep[b], {})
+        cat = a.get("hlo_category", "")
+        src = a.get("source", "")
+        src = re.sub(r"^.*?/(deepspeed_tpu/|bench)", r"\1", src)
+        lines.append(
+            f"| {us / 1e3:9.2f} | {100 * us / total:4.1f} | `{b}` "
+            f"| {cat} | {src} |"
+        )
+    md = "\n".join(lines) + "\n"
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(md)
+    print(md)
+
+
+if __name__ == "__main__":
+    main()
