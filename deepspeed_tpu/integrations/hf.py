@@ -23,6 +23,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import TransformerConfig, TransformerModel
@@ -530,7 +531,10 @@ def write_safetensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
     blobs = []
     for name, arr in tensors.items():
         arr = np.ascontiguousarray(arr)
-        code = rev.get(arr.dtype.type)
+        if arr.dtype == jnp.bfloat16:  # ml_dtypes: raw 2-byte LE payload
+            code = "BF16"
+        else:
+            code = rev.get(arr.dtype.type)
         if code is None:
             arr = arr.astype(np.float32)
             code = "F32"

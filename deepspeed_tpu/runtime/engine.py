@@ -17,6 +17,7 @@ imperative forward/backward/step machinery:
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
 import jax
@@ -67,6 +68,7 @@ def initialize(
     dist_init_required=None,
     config=None,
     config_params=None,
+    mpu=None,
     topology: Optional[MeshTopology] = None,
     rng: Optional[jax.Array] = None,
 ):
@@ -74,7 +76,10 @@ def initialize(
 
     ``model`` follows the model protocol (init/loss/partition_specs — see
     models/transformer.TransformerModel). ``optimizer`` may be an optax
-    GradientTransformation to override the config-built one.
+    GradientTransformation to override the config-built one. ``mpu``
+    (reference: Megatron model-parallel unit) is accepted as an alternate
+    spelling of the mesh shape: its get_*_parallel_world_size() methods
+    seed ParallelDims when no explicit ``topology`` is given.
     """
     if config is None:
         config = config_params
@@ -87,6 +92,39 @@ def initialize(
 
     cfg = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
 
+    if topology is None and mpu is not None and not comm.is_initialized():
+        # mpu protocol: the reference reads tensor/pipeline sizes off the
+        # Megatron mpu. mpu overrides the config's tp/pp; the other mesh
+        # axes (sp/ep/fsdp) still come from the config exactly like the
+        # no-mpu branch below, and a pp the config can't run (no pipeline
+        # section → no stage layers → TpuEngine) is an error, not a
+        # silently replicated mesh axis.
+        def _mpu_size(*names):
+            for n in names:
+                fn = getattr(mpu, n, None)
+                if callable(fn):
+                    return int(fn())
+            return 1
+
+        mpu_pp = _mpu_size("get_pipe_parallel_world_size",
+                           "get_pipeline_model_parallel_world_size")
+        if mpu_pp > 1 and cfg.pipeline.stages <= 1:
+            raise ValueError(
+                f"mpu reports pipeline world size {mpu_pp} but the config "
+                "has no pipeline section (pipeline.stages) — the engine "
+                "cannot place stage layers it doesn't know about"
+            )
+        topology = comm.init_distributed(dims=ParallelDims(
+            tp=_mpu_size("get_tensor_model_parallel_world_size",
+                         "get_model_parallel_world_size"),
+            pp=mpu_pp if mpu_pp > 1 else cfg.pipeline.stages,
+            sp=cfg.sequence_parallel.sp_size,
+            ep=cfg.moe.ep_size if cfg.moe.enabled else 1,
+            fsdp=(cfg.zero_config.zero_hpz_partition_size
+                  if cfg.zero_config.zero_hpz_partition_size > 1
+                  else (cfg.zero_config.mics_shard_size
+                        if cfg.zero_config.mics_shard_size > 0 else 1)),
+        ))
     if topology is None:
         if comm.is_initialized():
             topology = comm.get_topology()
@@ -1363,6 +1401,56 @@ class TpuEngine:
             "opt_state", self.state.opt_state, blocking=blocking
         )
         self.state.opt_state = None
+
+    def save_16bit_model(self, save_dir, save_filename="model.safetensors"):
+        """Parity: DeepSpeedEngine.save_16bit_model (deepspeed/runtime/
+        engine.py) — consolidate the (possibly ZeRO-sharded) weights into
+        ONE bf16 safetensors file, no optimizer state. For the recognized
+        model families (llama/mistral/gpt2/bloom/mixtral) the keys are the
+        HF state_dict names, so transformers can load the file directly
+        (the reference's stated use for a consolidated 16-bit export);
+        other models fall back to the checkpoint's internal keystr names
+        for same-framework reload. Every process participates in the
+        gather; the writer process writes and everyone barriers so no
+        process races ahead of the file."""
+        from ..integrations.hf import export_hf_state_dict, write_safetensors
+        from .checkpointing import _barrier, _is_writer, _leaf_paths, _to_host
+
+        host = jax.tree.map(_to_host, self.state.params)
+        fam = str(getattr(self.model.config, "name", "")).split("-")[0].lower()
+        try:
+            flat = export_hf_state_dict(host, self.model.config, fam)
+        except Exception:  # unknown family/layout: internal names
+            flat = dict(zip(_leaf_paths(host),
+                            jax.tree_util.tree_leaves(host)))
+        flat = {
+            k: (np.asarray(v).astype(jnp.bfloat16)  # ml_dtypes scalar type
+                if np.issubdtype(np.asarray(v).dtype, np.floating)
+                else np.asarray(v))
+            for k, v in flat.items()
+        }
+        path = os.path.join(save_dir, save_filename)
+        if _is_writer():
+            os.makedirs(save_dir, exist_ok=True)
+            write_safetensors(path, flat)
+        _barrier("save_16bit_model")
+        return path
+
+    @contextmanager
+    def no_sync(self):
+        """Parity shim: DeepSpeedEngine.no_sync. Gradient sync here is not
+        a hook to suppress — accumulation is a jitted scan and the data-
+        parallel mean happens once at the boundary inside the compiled
+        step, so there is nothing to skip; micro-steps never pay a sync.
+        Kept for train-loop portability. Like the reference, it refuses
+        under ZeRO >= 2 (there the reduce IS the partitioning and a user
+        expecting deferred sync would silently get wrong semantics)."""
+        if self.config.zero_config.stage >= 2:
+            raise RuntimeError(
+                "no_sync is not supported with ZeRO stage >= 2 "
+                "(gradient reduce-scatter is the partitioning step)"
+            )
+        yield
 
     # --------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None):

@@ -27,7 +27,7 @@ def main():
         model=model, config=cfg_path, training_data=synthetic_dataset()
     )
     data = RepeatingLoader(loader)
-    for step in range(200):
+    for step in range(int(os.environ.get("STEPS", 200))):
         loss = engine.train_batch(data_iter=data)
         if step % 50 == 0:
             engine.save_checkpoint("ckpts")

@@ -386,3 +386,82 @@ def test_zero_shims(tmp_path):
         wq = host["layers"]["attn"]["wq"]
         assert isinstance(wq, np.ndarray)
         assert wq.shape == tuple(eng.state.params["layers"]["attn"]["wq"].shape)
+
+
+def test_save_16bit_model(tmp_path):
+    """save_16bit_model consolidates ZeRO-sharded weights into ONE bf16
+    safetensors file under HF state_dict names (gpt2 here, so transformers
+    could load it), tensors matching the live gathered params."""
+    from deepspeed_tpu.integrations.hf import (
+        export_hf_state_dict, read_safetensors,
+    )
+    from deepspeed_tpu.runtime.checkpointing import _to_host
+
+    engine = make_engine(zero_stage=3)
+    engine.train_batch(batch=batch())
+    path = engine.save_16bit_model(str(tmp_path))
+    got = read_safetensors(path)  # reader widens BF16 -> fp32
+    assert got, "empty 16bit export"
+    host = jax.tree.map(_to_host, engine.state.params)
+    ref_sd = export_hf_state_dict(host, engine.model.config, "gpt2")
+    assert set(got) == set(ref_sd)
+    for name, arr in got.items():
+        ref = np.asarray(ref_sd[name]).astype(jnp.bfloat16).astype(np.float32)
+        np.testing.assert_array_equal(arr, ref, err_msg=name)
+    engine.destroy()
+
+
+def test_no_sync_parity_shim():
+    """no_sync is a no-op under ZeRO<=1 (accumulation already defers the
+    dp mean into the compiled step) and refuses under ZeRO>=2, like the
+    reference."""
+    engine = make_engine(zero_stage=1)
+    with engine.no_sync():
+        engine.train_batch(batch=batch())
+    engine.destroy()
+    engine = make_engine(zero_stage=2)
+    with pytest.raises(RuntimeError, match="ZeRO stage >= 2"):
+        with engine.no_sync():
+            pass
+    engine.destroy()
+
+
+def test_initialize_accepts_mpu():
+    """initialize(mpu=...) seeds the mesh from the Megatron mpu protocol."""
+    import deepspeed_tpu.comm as comm
+
+    class FakeMpu:
+        def get_tensor_model_parallel_world_size(self):
+            return 2
+
+        def get_pipe_parallel_world_size(self):
+            return 1
+
+    comm.destroy_process_group()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(),
+        config={
+            "train_batch_size": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        },
+        mpu=FakeMpu(),
+    )
+    assert engine.topology.tp_size == 2
+    engine.train_batch(batch=batch(n=4))
+    engine.destroy()
+    comm.destroy_process_group()
+
+    class PipeMpu(FakeMpu):
+        def get_pipe_parallel_world_size(self):
+            return 2
+
+    with pytest.raises(ValueError, match="no pipeline section"):
+        deepspeed_tpu.initialize(
+            model=tiny_model(),
+            config={
+                "train_batch_size": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            },
+            mpu=PipeMpu(),
+        )
+    comm.destroy_process_group()
