@@ -1,3 +1,9 @@
+from .inspection import (  # noqa: F401
+    safe_get_full_fp32_param,
+    safe_get_full_grad,
+    safe_get_full_optimizer_state,
+    safe_set_full_fp32_param,
+)
 from .logging import log_dist, logger, warning_once  # noqa: F401
 from .memory import (  # noqa: F401
     estimate_zero2_model_states_mem_needs,

@@ -1,0 +1,168 @@
+"""ZeRO-safe param/grad/optimizer-state inspection.
+
+Parity: deepspeed.utils safe_get_full_fp32_param /
+safe_set_full_fp32_param / safe_get_full_optimizer_state /
+safe_get_full_grad (deepspeed/utils/__init__.py) — the API RLHF/trainer
+code uses to read or patch full (unsharded) values under ZeRO without
+touching partitioning internals. The reference takes a torch parameter
+object; the functional translation addresses leaves by name — the same
+keystr path the sharded checkpoint uses (runtime/checkpointing), or any
+unique substring of it.
+
+Gather semantics: leaves are materialized to host fp32 via the
+checkpoint's _to_host (multi-host non-addressable shards all-gather).
+Grads: the engine's step is one fused program and gradients are values
+inside it, not buffers — safe_get_full_grad computes them on demand over
+the microbatches currently buffered by the imperative
+forward()/backward() protocol (the window where the reference's version
+is valid), one compiled fwd+bwd per microbatch, averaged. Outside that
+window it returns None, like the reference outside backward.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def _leaf_map(tree) -> dict:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): (path, leaf) for path, leaf in flat}
+
+
+def _resolve(tree, name: str):
+    """(path, leaf) for an exact keystr or a unique substring match."""
+    leaves = _leaf_map(tree)
+    if name in leaves:
+        return leaves[name]
+    hits = [k for k in leaves if name in k]
+    if not hits:
+        raise KeyError(f"no parameter leaf matches {name!r}")
+    if len(hits) > 1:
+        raise KeyError(
+            f"{name!r} is ambiguous: matches {sorted(hits)[:5]}"
+            f"{'...' if len(hits) > 5 else ''}"
+        )
+    return leaves[hits[0]]
+
+
+def _to_host_fp32(leaf) -> np.ndarray:
+    from ..runtime.checkpointing import _to_host
+
+    arr = _to_host(leaf)
+    return arr.astype(np.float32) if np.issubdtype(
+        arr.dtype, np.floating) else arr
+
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Full (gathered) fp32 master weight for the named leaf."""
+    _, leaf = _resolve(engine.state.params, name)
+    return _to_host_fp32(leaf)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """Overwrite the named master weight from a full host array; the value
+    is re-sharded to the leaf's existing sharding."""
+    path, leaf = _resolve(engine.state.params, name)
+    value = np.asarray(value, dtype=np.float32)
+    if value.shape != tuple(leaf.shape):
+        raise ValueError(
+            f"shape mismatch for {name!r}: got {value.shape}, "
+            f"param is {tuple(leaf.shape)}"
+        )
+    new_leaf = jax.device_put(
+        value.astype(leaf.dtype), leaf.sharding
+    )
+    key = jax.tree_util.keystr(path)
+
+    def swap(p, l):
+        return new_leaf if jax.tree_util.keystr(p) == key else l
+
+    engine.state.params = jax.tree_util.tree_map_with_path(
+        swap, engine.state.params
+    )
+
+
+_OPT_STATE_KEYS = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+
+
+def safe_get_full_optimizer_state(engine, name: str,
+                                  state_key: str) -> np.ndarray:
+    """Full fp32 optimizer state ("exp_avg"/"exp_avg_sq", or a raw optax
+    field name like "mu"/"nu") for the named parameter."""
+    field = _OPT_STATE_KEYS.get(state_key, state_key)
+    if getattr(engine, "_nvme_swapper", None) is not None:
+        engine._swap_in_opt()
+    # optax states are NamedTuples (ScaleByAdamState has .mu/.nu): stop
+    # flattening at the first node exposing the wanted field
+    for part in jax.tree_util.tree_leaves(
+        engine.state.opt_state,
+        is_leaf=lambda x: hasattr(x, field),
+    ):
+        if hasattr(part, field):
+            tree = getattr(part, field)
+            try:
+                _, leaf = _resolve(tree, name)
+            except KeyError:
+                continue
+            return _to_host_fp32(leaf)
+    raise KeyError(
+        f"optimizer state {state_key!r} not found for {name!r} "
+        "(is the optimizer adam-family?)"
+    )
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Full fp32 gradient of the named leaf over the microbatches buffered
+    by forward()/backward(); None outside that window (same contract as
+    the reference outside loss.backward()).
+
+    Computed fresh on every call — grads are values inside the fused step
+    program, not buffers, so this runs one fwd+bwd per buffered microbatch
+    (compiled once) and averages. No result cache: a cache keyed on
+    engine state can serve stale grads after a weight patch or an
+    overflow-skipped step, and it would pin a model-sized grads tree in
+    device memory for the rest of the run. This is a debug/inspection
+    API; recompute is the honest cost."""
+    import jax.numpy as jnp
+
+    buffer = getattr(engine, "_micro_buffer", None)
+    if not buffer:
+        return None
+    from ..models.sharding import use_topology
+    from ..models.transformer import make_lm_batch
+
+    fn = getattr(engine, "_inspect_grad_fn", None)
+    if fn is None:
+        # one microbatch's mean grads, unscaled fp32 (mirrors the
+        # engine's accum==1 fast path in _compute_grads)
+        def one_micro(params, mb, key, scale):
+            grad_fn = jax.value_and_grad(engine._loss_for, has_aux=True)
+            _, grads = grad_fn(params, mb, key, scale, None, None)
+            inv = 1.0 / scale
+            return jax.tree.map(
+                lambda g: g.astype(jnp.float32) * inv, grads
+            )
+
+        fn = jax.jit(one_micro)
+        engine._inspect_grad_fn = fn
+
+    scale = (engine.state.loss_scale.scale if engine.fp16_enabled
+             else jnp.ones((), jnp.float32))
+    sharding = engine._batch_sharding(accum_leading=False)
+    acc = None
+    with use_topology(engine.topology):
+        for mb in buffer:
+            if "labels" not in mb:
+                mb = make_lm_batch(jnp.asarray(mb["input_ids"]))
+            prepared = {
+                k: jax.device_put(np.asarray(v), sharding)
+                for k, v in mb.items()
+            }
+            g = fn(engine.state.params, prepared, engine.next_rng(), scale)
+            _, leaf = _resolve(g, name)
+            leaf = _to_host_fp32(leaf)
+            acc = leaf if acc is None else acc + leaf
+    return acc / len(buffer)
