@@ -61,8 +61,15 @@ def enable_compile_cache():
 def bench_dims(smoke: bool):
     """(B, S) of the bench batch, computable without touching jax — the
     sweep parent needs the grid geometry while the model only ever
-    compiles inside per-point child processes."""
-    return (4, 256) if smoke else (8, 2048)
+    compiles inside per-point child processes.
+
+    BENCH_SEQ overrides the sequence length (long-context variant for the
+    watcher's 8k leg); the global batch shrinks to hold the token count
+    at the default 16384/step so records stay comparable."""
+    if smoke:
+        return (4, 256)
+    seq = int(os.environ.get("BENCH_SEQ", 2048))
+    return (max(16384 // seq, 1), seq)
 
 
 def bench_model_and_data(smoke: bool):
@@ -135,6 +142,16 @@ def main():
     # per-device micro-batch bounds: the batch triangle requires
     # B == micro * accum * dp, so the largest valid micro is B // dp
     dp = max(len(jax.devices()), 1)
+    if B % dp:
+        # a BENCH_SEQ-shrunk batch must still divide the device count or
+        # every ladder rung fails the batch triangle; regenerate the data
+        # at the rounded-up size (same seed → same leading rows)
+        B = -(-B // dp) * dp
+        data = {
+            "input_ids": np.random.RandomState(0).randint(
+                0, model.config.vocab_size, size=(B, S)
+            )
+        }
     mb_full = max(B // dp, 1)
     mb_half = max(mb_full // 2, 1)
     kernels_on = {}  # engine defaults (flash + fused CE auto-on for TPU)
@@ -266,6 +283,11 @@ def main():
             pass
     baseline = max(priors) if priors else None
     vs = tok_per_sec / baseline if baseline else 1.0
+    if os.environ.get("BENCH_SEQ") and S != 2048:
+        # the BENCH_r*.json priors were recorded at seq2048; tokens/sec at
+        # a different sequence length is not comparable (attention grows
+        # quadratically) — don't report a phantom regression
+        vs = 1.0
     if smoke:
         # CPU validation run: TPU-peak MFU and real-TPU priors are
         # meaningless here — don't feed a ratchet false regressions
@@ -277,7 +299,8 @@ def main():
                 "metric": (
                     "SMOKE-MODE bench validation (not a perf record)"
                     if smoke
-                    else "llama-410M train tokens/sec/chip (bf16, seq2048, MFU attached)"
+                    else ("llama-410M train tokens/sec/chip "
+                          f"(bf16, seq{S}, MFU attached)")
                 ),
                 "value": round(tok_per_sec, 1),
                 "unit": "tokens/sec/chip",

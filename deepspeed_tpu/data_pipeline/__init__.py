@@ -1,4 +1,8 @@
 from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .indexed_dataset import (  # noqa: F401
+    IndexedDatasetBuilder,
+    MMapIndexedDataset,
+)
 from .data_analyzer import (  # noqa: F401
     CurriculumSampler,
     DataAnalyzer,

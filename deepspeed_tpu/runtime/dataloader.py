@@ -52,10 +52,19 @@ class DeepSpeedDataLoader:
     ):
         if isinstance(dataset, (np.ndarray, jax.Array)):
             dataset = {"input_ids": dataset}
-        self.data = {k: np.asarray(v) for k, v in dataset.items()}
-        lengths = {len(v) for v in self.data.values()}
-        assert len(lengths) == 1, f"ragged dataset fields: { {k: len(v) for k, v in self.data.items()} }"
-        self.n = lengths.pop()
+        if hasattr(dataset, "items"):
+            self.dataset = None
+            self.data = {k: np.asarray(v) for k, v in dataset.items()}
+            lengths = {len(v) for v in self.data.values()}
+            assert len(lengths) == 1, f"ragged dataset fields: { {k: len(v) for k, v in self.data.items()} }"
+            self.n = lengths.pop()
+        else:
+            # map-style dataset (__getitem__/__len__ — e.g. the indexed
+            # .bin/.idx MMapIndexedDataset): rows are fetched per batch,
+            # via the dataset's own batched gather when it has one
+            self.dataset = dataset
+            self.data = None
+            self.n = len(dataset)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
@@ -69,6 +78,18 @@ class DeepSpeedDataLoader:
             return self.n // self.batch_size
         return (self.n + self.batch_size - 1) // self.batch_size
 
+    def _gather(self, idx) -> Dict[str, np.ndarray]:
+        if self.data is not None:
+            return {k: v[idx] for k, v in self.data.items()}
+        ds = self.dataset
+        if hasattr(ds, "get_batch") and getattr(ds, "seqlen", None):
+            return {"input_ids": ds.get_batch(idx, ds.seqlen)}
+        rows = [ds[int(i)] for i in idx]
+        if rows and isinstance(rows[0], dict):
+            return {k: np.stack([np.asarray(r[k]) for r in rows])
+                    for k in rows[0]}
+        return {"input_ids": np.stack([np.asarray(r) for r in rows])}
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         order = np.arange(self.n)
         if self.shuffle:
@@ -76,7 +97,7 @@ class DeepSpeedDataLoader:
         self.epoch += 1
         for i in range(len(self)):
             idx = order[i * self.batch_size : (i + 1) * self.batch_size]
-            batch = {k: v[idx] for k, v in self.data.items()}
+            batch = self._gather(idx)
             if self.curriculum_fn is not None:
                 seqlen = int(self.curriculum_fn(self.global_step))
                 batch = {

@@ -93,25 +93,33 @@ def safe_get_full_optimizer_state(engine, name: str,
     """Full fp32 optimizer state ("exp_avg"/"exp_avg_sq", or a raw optax
     field name like "mu"/"nu") for the named parameter."""
     field = _OPT_STATE_KEYS.get(state_key, state_key)
-    if getattr(engine, "_nvme_swapper", None) is not None:
+    swapped = getattr(engine, "_nvme_swapper", None) is not None
+    if swapped:
         engine._swap_in_opt()
-    # optax states are NamedTuples (ScaleByAdamState has .mu/.nu): stop
-    # flattening at the first node exposing the wanted field
-    for part in jax.tree_util.tree_leaves(
-        engine.state.opt_state,
-        is_leaf=lambda x: hasattr(x, field),
-    ):
-        if hasattr(part, field):
-            tree = getattr(part, field)
-            try:
-                _, leaf = _resolve(tree, name)
-            except KeyError:
-                continue
-            return _to_host_fp32(leaf)
-    raise KeyError(
-        f"optimizer state {state_key!r} not found for {name!r} "
-        "(is the optimizer adam-family?)"
-    )
+    try:
+        # optax states are NamedTuples (ScaleByAdamState has .mu/.nu): stop
+        # flattening at the first node exposing the wanted field
+        for part in jax.tree_util.tree_leaves(
+            engine.state.opt_state,
+            is_leaf=lambda x: hasattr(x, field),
+        ):
+            if hasattr(part, field):
+                tree = getattr(part, field)
+                try:
+                    _, leaf = _resolve(tree, name)
+                except KeyError:
+                    continue
+                return _to_host_fp32(leaf)
+        raise KeyError(
+            f"optimizer state {state_key!r} not found for {name!r} "
+            "(is the optimizer adam-family?)"
+        )
+    finally:
+        if swapped:
+            # keep the "on disk between steps" invariant — a read-only
+            # inspection must not leave the state resident and OOM the
+            # next step (same pairing as engine.save_checkpoint)
+            engine._swap_out_opt()
 
 
 def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
@@ -154,14 +162,18 @@ def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
     sharding = engine._batch_sharding(accum_leading=False)
     acc = None
     with use_topology(engine.topology):
-        for mb in buffer:
+        for k_mb, mb in enumerate(buffer):
             if "labels" not in mb:
                 mb = make_lm_batch(jnp.asarray(mb["input_ids"]))
             prepared = {
                 k: jax.device_put(np.asarray(v), sharding)
                 for k, v in mb.items()
             }
-            g = fn(engine.state.params, prepared, engine.next_rng(), scale)
+            # fold_in, never next_rng(): a read-only inspection must not
+            # advance the training rng stream (it would silently break
+            # bitwise reproducibility of the run it is inspecting)
+            key = jax.random.fold_in(engine._rng, k_mb)
+            g = fn(engine.state.params, prepared, key, scale)
             _, leaf = _resolve(g, name)
             leaf = _to_host_fp32(leaf)
             acc = leaf if acc is None else acc + leaf

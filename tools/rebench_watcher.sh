@@ -20,11 +20,13 @@ DONE_MOE_G=perf/.rebench_moe_gather_done
 DONE_TILE=perf/.rebench_tile_done
 DONE_INT8=perf/.rebench_decode_int8_done
 DONE_FADAM=perf/.rebench_fused_adam_done
+DONE_SEQ8K=perf/.rebench_seq8k_done
 tile_fails=0
 moe_e_fails=0
 moe_g_fails=0
 int8_fails=0
 fadam_fails=0
+seq8k_fails=0
 
 pool_up() {
     timeout 120 python -c \
@@ -85,6 +87,21 @@ for i in $(seq 1 "$ATTEMPTS"); do
                 && echo "[rebench] moe gather pruned" && touch "$DONE_MOE_G"
         fi
     fi
+    # long-context leg: seq 8192 at the same 16384 tokens/step (flash DMA
+    # skip + dots_flash are exactly the levers long context stresses)
+    if [ ! -f "$DONE_SEQ8K" ]; then
+        BENCH_SEQ=8192 timeout 1800 python bench.py \
+            > perf/bench_seq8192.json 2>&1
+        rc=$?
+        echo "[rebench] bench seq8192 rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_SEQ8K"
+        else
+            seq8k_fails=$((seq8k_fails + 1))
+            [ "$seq8k_fails" -ge 2 ] \
+                && echo "[rebench] seq8192 bench pruned" && touch "$DONE_SEQ8K"
+        fi
+    fi
     # fused-adam A/B: xprof r4 put the optax update + clip tail at ~5% of
     # step; same bench ladder with the Pallas fused adam swapped in
     if [ ! -f "$DONE_FADAM" ]; then
@@ -137,7 +154,8 @@ for i in $(seq 1 "$ATTEMPTS"); do
     fi
     if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE_E" ] \
         && [ -f "$DONE_MOE_G" ] && [ -f "$DONE_INT8" ] \
-        && [ -f "$DONE_FADAM" ] && [ -f "$DONE_TILE" ]; then
+        && [ -f "$DONE_FADAM" ] && [ -f "$DONE_SEQ8K" ] \
+        && [ -f "$DONE_TILE" ]; then
         echo "[rebench] done $(date -u +%FT%TZ)"
         exit 0
     fi
