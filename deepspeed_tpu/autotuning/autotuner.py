@@ -27,6 +27,9 @@ REMAT_POLICIES = ("none", "dots_flash", "attn_mlp", "full")
 # flash_attention.DEFAULT_BLOCK_*) so phase 2 would re-measure the (0, 0)
 # phase-1 winner; 512x1024 is the measured v5e S=2048 winner
 FLASH_BLOCKS = ((0, 0), (512, 1024), (512, 256), (256, 512), (128, 128))
+# phase-3 backward-tile candidates (dq/dkv kernels); fwd tiles stay at the
+# phase-2 winner. Excludes (0, 0): that IS the phase-2 result (inherit).
+FLASH_BLOCKS_BWD = ((512, 512), (256, 512), (512, 256))
 
 
 def _is_oom(err: Exception) -> bool:
@@ -100,11 +103,14 @@ class Autotuner:
         blocks = tuple(blocks) + (0,) * (4 - len(blocks))  # (bq,bk[,bqb,bkb])
         if any(blocks):
             tk = dict(cfg.get("tpu_kernels") or {})
-            # bwd keys assigned unconditionally: a candidate's 0 means
-            # "inherit the fwd tile" and must overwrite any stale bwd
-            # override inherited from the base config, or the record would
-            # claim tiles the measurement didn't run with
-            tk["flash_block_q"], tk["flash_block_k"] = blocks[:2]
+            # fwd keys only when the candidate names them: a bwd-only
+            # candidate (0,0,bqb,bkb) must keep the base config's fwd
+            # tiles, or the measurement and the emitted patch describe
+            # different configurations. bwd keys assigned whenever the
+            # candidate is non-default: its 0 means "inherit fwd" and
+            # must overwrite a stale base-config bwd override.
+            if blocks[0] or blocks[1]:
+                tk["flash_block_q"], tk["flash_block_k"] = blocks[:2]
             tk["flash_block_q_bwd"], tk["flash_block_k_bwd"] = blocks[2:]
             cfg["tpu_kernels"] = tk
         cfg.setdefault("steps_per_print", 10**9)
@@ -218,6 +224,28 @@ class Autotuner:
                 log_dist(
                     f"autotune: blocks={blocks}: {tput:.0f} tok/s"
                 )
+                if tput > best["throughput"]:
+                    best = rec
+            # phase 3: backward tiles on the winner — the dq/dkv kernels'
+            # operand mix differs from the fwd's, so their best shape is
+            # its own small search (0,0 = inherit fwd, the phase-2 result)
+            fwd = (best.get("flash_block_q", 0), best.get("flash_block_k", 0))
+            for bwd in FLASH_BLOCKS_BWD:
+                blocks = (*fwd, *bwd)
+                tput = self._measure(
+                    best["micro_batch"], best["remat_policy"], blocks
+                )
+                if tput is None:
+                    continue
+                rec = {
+                    "micro_batch": best["micro_batch"],
+                    "remat_policy": best["remat_policy"],
+                    "flash_block_q": fwd[0], "flash_block_k": fwd[1],
+                    "flash_block_q_bwd": bwd[0], "flash_block_k_bwd": bwd[1],
+                    "throughput": tput,
+                }
+                self.results.append(rec)
+                log_dist(f"autotune: bwd blocks={bwd}: {tput:.0f} tok/s")
                 if tput > best["throughput"]:
                     best = rec
         return best

@@ -341,6 +341,30 @@ def _load_tree(template, directory: str, shardings=None, strict: bool = True,
     return tree
 
 
+_ZERO_TO_FP32_SCRIPT = '''\
+#!/usr/bin/env python
+"""Assemble the full fp32 model weights from this (possibly ZeRO-sharded)
+checkpoint directory — no engine, no config (parity: the zero_to_fp32.py
+the reference drops into every checkpoint). Thin shim over
+deepspeed_tpu.zero so the export logic has exactly one implementation.
+
+Usage: python zero_to_fp32.py <checkpoint_dir> <output.npz> [tag]
+"""
+import sys
+
+from deepspeed_tpu.zero import convert_zero_checkpoint_to_fp32_state_dict
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        raise SystemExit(__doc__)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        sys.argv[1], sys.argv[2],
+        tag=sys.argv[3] if len(sys.argv) > 3 else None,
+    )
+    print(f"wrote {sys.argv[2]}")
+'''
+
+
 def save_checkpoint(
     engine,
     save_dir: str,
@@ -385,6 +409,12 @@ def save_checkpoint(
         # reference layout: `latest` at the checkpoint root names the newest tag
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(tag)
+        # reference layout: every checkpoint root carries a runnable
+        # zero_to_fp32.py so weights are recoverable with no engine and no
+        # knowledge of this package's APIs (deepspeed's engine drops the
+        # same script via _save_zero_checkpoint)
+        with open(os.path.join(save_dir, "zero_to_fp32.py"), "w") as f:
+            f.write(_ZERO_TO_FP32_SCRIPT)
     _barrier("save_checkpoint")  # non-writers must not race ahead of the files
     log_dist(f"saved checkpoint {path}")
     return path

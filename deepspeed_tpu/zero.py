@@ -136,7 +136,11 @@ def convert_zero_checkpoint_to_fp32_state_dict(
     np.savez(output_file, **sd)
 
 
-if __name__ == "__main__":  # python -m deepspeed_tpu.zero <ckpt_dir> <out.npz>
+if __name__ == "__main__":
+    # python -m deepspeed_tpu.zero <ckpt_dir> <out.npz> [tag]
     import sys
 
-    convert_zero_checkpoint_to_fp32_state_dict(sys.argv[1], sys.argv[2])
+    convert_zero_checkpoint_to_fp32_state_dict(
+        sys.argv[1], sys.argv[2],
+        tag=sys.argv[3] if len(sys.argv) > 3 else None,
+    )

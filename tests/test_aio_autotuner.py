@@ -155,3 +155,47 @@ def test_measure_grid_and_config_patch_roundtrip(tmp_path):
                                                config=cfg)
     loss = float(engine.train_batch(batch=sample_batch(16)))
     assert np.isfinite(loss)
+
+
+def test_autotuner_phase3_bwd_tiles(monkeypatch):
+    """Phase 3 probes backward-only tile variants on the phase-2 winner and
+    records/propagates the bwd keys (config patch included)."""
+    from deepspeed_tpu.autotuning import Autotuner, result_to_config_patch
+    from deepspeed_tpu.autotuning import autotuner as at_mod
+
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    topo = MeshTopology(dims=ParallelDims(dp=8))
+    r = np.random.RandomState(0)
+    tuner = Autotuner(
+        model,
+        {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "autotuning": {"max_train_micro_batch_size_per_gpu": 1,
+                           "start_profile_step": 1, "end_profile_step": 2,
+                           "trials": 1},
+        },
+        topology=topo,
+        sample_batch_fn=lambda g: {
+            "input_ids": r.randint(0, 64, size=(g, 16))
+        },
+    )
+    monkeypatch.setattr(tuner, "_flash_tunable", lambda: True)
+    # deterministic throughputs: a bwd variant wins
+    scores = {(0, 0, 0, 0): 100.0, (256, 512, 0, 0): 110.0,
+              (256, 512, 512, 256): 120.0}
+
+    def fake_measure(mb, pol, blocks=(0, 0)):
+        b4 = tuple(blocks) + (0,) * (4 - len(blocks))
+        return scores.get(b4, 50.0)
+
+    monkeypatch.setattr(tuner, "_measure", fake_measure)
+    monkeypatch.setattr(at_mod, "FLASH_BLOCKS", ((0, 0), (256, 512)))
+    monkeypatch.setattr(at_mod, "FLASH_BLOCKS_BWD", ((512, 256),))
+    best = tuner.tune()
+    assert best["flash_block_q_bwd"] == 512
+    assert best["flash_block_k_bwd"] == 256
+    assert best["throughput"] == 120.0
+    patch = result_to_config_patch(best)
+    tk = patch["tpu_kernels"]
+    assert tk["flash_block_q_bwd"] == 512 and tk["flash_block_k_bwd"] == 256
