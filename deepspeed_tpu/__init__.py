@@ -13,6 +13,7 @@ from .config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
 from .comm import init_distributed  # noqa: F401
 from . import zero  # noqa: F401  (deepspeed.zero parity surface)
 from . import checkpointing  # noqa: F401  (deepspeed.checkpointing parity)
+from .accelerator import get_accelerator  # noqa: F401  (deepspeed.accelerator)
 
 
 def initialize(*args, **kwargs):

@@ -1,0 +1,151 @@
+"""Accelerator abstraction: the `get_accelerator()` user surface.
+
+Parity: deepspeed.accelerator.get_accelerator() /
+real_accelerator.py — the device-portable API DeepSpeed user code calls
+for device name/count, memory stats, synchronization, and rng seeding
+instead of hardcoding `torch.cuda`. The TPU translation answers from the
+jax backend; collective-free process-local queries only, so it is safe
+anywhere (including before comm.init_distributed).
+
+Reference call sites this mirrors: device_name(), device_count(),
+current_device()/current_device_name(), memory_allocated/
+max_memory_allocated/total_memory, empty_cache, synchronize,
+manual_seed, is_available, communication_backend_name.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+
+class TpuAccelerator:
+    """Process-local accelerator facade over the jax backend."""
+
+    _name: Optional[str] = None
+
+    # -------------------------------------------------------------- identity
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        kind = self._platform()
+        if device_index is None:
+            return kind
+        return f"{kind}:{device_index}"
+
+    def _platform(self) -> str:
+        if self._name is None:
+            try:
+                self._name = jax.default_backend()
+            except Exception:
+                self._name = "cpu"
+        return self._name
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    def device_count(self) -> int:
+        try:
+            return jax.local_device_count()
+        except Exception:
+            return 0
+
+    def current_device(self) -> int:
+        # SPMD: the process drives all its local devices; 0 is the
+        # canonical "current" one (the reference returns the bound ordinal)
+        return 0
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    def communication_backend_name(self) -> str:
+        return "xla"  # collectives are XLA ops over the mesh, not a library
+
+    def on_accelerator(self, tensor) -> bool:
+        try:
+            return isinstance(tensor, jax.Array)
+        except Exception:
+            return False
+
+    # ---------------------------------------------------------------- memory
+    def _check_index(self, device_index: int) -> int:
+        n = self.device_count()
+        if not 0 <= device_index < max(n, 1):
+            raise ValueError(
+                f"device_index {device_index} out of range "
+                f"({n} local devices)"
+            )
+        return device_index
+
+    def _stats(self, device_index: int = 0) -> dict:
+        from .utils.memory import _device_stats
+
+        return _device_stats(self._check_index(device_index))
+
+    def memory_allocated(self, device_index: int = 0) -> int:
+        return int(self._stats(device_index)["bytes_in_use"])
+
+    def max_memory_allocated(self, device_index: int = 0) -> int:
+        s = self._stats(device_index)
+        return int(s["peak_bytes_in_use"] or s["bytes_in_use"])
+
+    def total_memory(self, device_index: int = 0) -> int:
+        return int(self._stats(device_index)["bytes_limit"])
+
+    def available_memory(self, device_index: int = 0) -> int:
+        s = self._stats(device_index)
+        return max(int(s["bytes_limit"]) - int(s["bytes_in_use"]), 0)
+
+    def empty_cache(self) -> None:
+        # XLA's allocator is not user-flushable; live buffers are freed by
+        # dropping references (functional state). No-op by design.
+        return None
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Block until all dispatched device work completes.
+
+        A TPU device executes programs in enqueue order, so completing a
+        later-enqueued tiny COMPUTATION (not a bare transfer — PJRT runs
+        h2d transfers on their own stream) implies everything enqueued
+        before it has finished."""
+        try:
+            devs = jax.local_devices()
+        except Exception:
+            return
+        if not devs:
+            return
+        if device_index is not None:
+            devs = [devs[self._check_index(device_index)]]
+        fence = jax.jit(lambda x: x + 1)
+        for d in devs:
+            try:
+                jax.block_until_ready(fence(jax.device_put(0, d)))
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------- rng
+    def manual_seed(self, seed: int):
+        """Returns a jax PRNG key (functional rng: the key IS the seed
+        state; there is no global generator to set)."""
+        return jax.random.PRNGKey(int(seed))
+
+    manual_seed_all = manual_seed
+
+    # ----------------------------------------------------------------- dtype
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True  # emulated via loss scaling; bf16 is the native type
+
+
+_ACCEL: Optional[TpuAccelerator] = None
+_LOCK = threading.Lock()
+
+
+def get_accelerator() -> TpuAccelerator:
+    global _ACCEL
+    with _LOCK:
+        if _ACCEL is None:
+            _ACCEL = TpuAccelerator()
+    return _ACCEL

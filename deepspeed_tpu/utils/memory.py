@@ -17,12 +17,12 @@ from .logging import log_dist
 _GB = 1 << 30
 
 
-def _device_stats() -> Dict[str, float]:
+def _device_stats(device_index: int = 0) -> Dict[str, float]:
     import jax
 
     try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
+        stats = jax.local_devices()[device_index].memory_stats() or {}
+    except Exception:  # no devices / backend without allocator stats
         stats = {}
     return {
         "bytes_in_use": float(stats.get("bytes_in_use", 0)),

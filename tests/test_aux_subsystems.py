@@ -432,3 +432,38 @@ def test_throughput_timer_wired_into_engine(devices8, monkeypatch):
     assert engine.tput.step_count == 6
     assert engine.tput.avg_samples_per_sec > 0
     assert any("samples/sec=" in m for m in lines)  # step-6 log line
+
+
+def test_get_accelerator_surface():
+    """deepspeed.accelerator parity: device identity, memory stats,
+    synchronize, functional rng seeding."""
+    import jax
+
+    from deepspeed_tpu import get_accelerator
+
+    acc = get_accelerator()
+    assert acc is get_accelerator()  # singleton
+    assert acc.is_available() and acc.device_count() >= 1
+    assert acc.device_name().lower() in ("cpu", "tpu", "axon")
+    assert acc.device_name(0).endswith(":0")
+    assert acc.communication_backend_name() == "xla"
+    # memory stats are ints (0 on backends without allocator stats)
+    assert isinstance(acc.memory_allocated(), int)
+    assert acc.available_memory() >= 0
+    acc.synchronize()  # must not raise
+    key = acc.manual_seed(7)
+    assert (jax.random.key_data(key) == jax.random.key_data(
+        jax.random.PRNGKey(7))).all()
+    x = jax.numpy.ones((2,))
+    assert acc.on_accelerator(x) and not acc.on_accelerator([1, 2])
+    assert acc.is_bf16_supported()
+
+
+def test_accelerator_bad_index_raises():
+    from deepspeed_tpu import get_accelerator
+
+    acc = get_accelerator()
+    with pytest.raises(ValueError, match="out of range"):
+        acc.memory_allocated(acc.device_count() + 3)
+    with pytest.raises(ValueError, match="out of range"):
+        acc.synchronize(-1)
