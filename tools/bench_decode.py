@@ -58,15 +58,18 @@ def main():
     )
     draft = None
     if args.speculative:
+        # head_dim 128 keeps the DRAFT on the Pallas decode kernel too —
+        # the draft loop is the latency-critical part of speculation, and
+        # hd=64 silently fell back to the XLA path (r4 decode bench logs)
         draft = llama(
             "llama-tiny",
             vocab_size=1024 if smoke else 32768,
             max_seq_len=256 if smoke else 2048,
             hidden_size=128 if smoke else 512,
             num_layers=2,
-            num_heads=8,
-            num_kv_heads=4,
-            head_dim=16 if smoke else 64,
+            num_heads=8 if smoke else 4,
+            num_kv_heads=4 if smoke else 2,
+            head_dim=16 if smoke else 128,
             intermediate_size=512 if smoke else 2048,
         )
     engine = deepspeed_tpu.init_inference(
