@@ -1,0 +1,140 @@
+"""Standalone Pallas-kernel micro-benchmarks: MXU efficiency per op.
+
+The training bench measures the whole step; this isolates each hot
+kernel at the bench shapes so tile changes can be timed in seconds
+instead of through a full-model compile (the r4 xprof analysis derived
+"flash fwd ≈ 10% MXU at 256 tiles" by hand — this makes that number a
+command). Prints one JSON line per op:
+  {"op": ..., "ms": ..., "tflops": ..., "mxu_frac": ...}
+
+Usage:  python tools/bench_kernels.py [--ops flash_fwd,flash_bwd,...]
+        [--bq N] [--bk N] [--bqb N] [--bkb N]
+CPU smoke: BENCH_SMOKE=1 (tiny shapes, interpret kernels, timing noise
+is fine — this validates the harness, not the numbers).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRIALS = 5
+
+
+def _time(fn, *args):
+    import jax
+
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="flash_fwd,flash_bwd,rmsnorm,decode")
+    ap.add_argument("--bq", type=int, default=0)
+    ap.add_argument("--bk", type=int, default=0)
+    ap.add_argument("--bqb", type=int, default=0)
+    ap.add_argument("--bkb", type=int, default=0)
+    args = ap.parse_args()
+
+    from bench import peak_flops_per_chip, smoke_mode
+
+    smoke = smoke_mode()
+    peak = peak_flops_per_chip()  # per-generation, same source as bench MFU
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas.flash_attention import (
+        block_sizes_scope, flash_attention,
+    )
+
+    B, S, H, KV, D = (1, 256, 2, 2, 64) if smoke else (4, 2048, 8, 4, 128)
+    r = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(r, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, KV, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, KV, D), jnp.bfloat16)
+    # causal: half the S^2 tiles do MXU work
+    fwd_flops = 4 * B * H * S * S * D * 0.5
+    ops = set(filter(None, args.ops.split(",")))
+    scope = block_sizes_scope(args.bq, args.bk, args.bqb, args.bkb)
+
+    def emit(op, sec, flops):
+        # _time returns SECONDS
+        tf = flops / sec / 1e12 if sec > 0 else 0.0
+        print(json.dumps({
+            "op": op, "ms": round(sec * 1e3, 3), "tflops": round(tf, 2),
+            "mxu_frac": round(tf * 1e12 / peak, 4),
+            "blocks": [args.bq, args.bk, args.bqb, args.bkb],
+            "smoke": smoke,
+        }), flush=True)
+
+    with scope:
+        if "flash_fwd" in ops:
+            f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+            emit("flash_fwd", _time(f, q, k, v), fwd_flops)
+        if "flash_bwd" in ops:
+            g = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32) ** 2
+                ),
+                argnums=(0, 1, 2),
+            ))
+            # fwd (recompute inside vjp residual use) + dq + dkv ≈ 2.5x fwd
+            emit("flash_fwd+bwd", _time(g, q, k, v), fwd_flops * 3.5)
+        if "rmsnorm" in ops:
+            from deepspeed_tpu.ops.pallas.rmsnorm import rmsnorm
+
+            x = jax.random.normal(r, (B * S, H * D), jnp.bfloat16)
+            w = jnp.ones((H * D,), jnp.bfloat16)
+            f = jax.jit(lambda x, w: rmsnorm(x, w))
+            # bandwidth-bound: report bytes-derived "tflops" as 0-ish; use
+            # elementwise flops (~5 per value) for a consistent field
+            emit("rmsnorm", _time(f, x, w), x.size * 5)
+        if "decode" in ops:
+            from deepspeed_tpu.ops.pallas.decode_attention import (
+                decode_attention,
+            )
+
+            Smax = 256 if smoke else 2048
+            qd = jax.random.normal(kq, (B, 1, H, D), jnp.bfloat16)
+            kc = jax.random.normal(kk, (B, Smax, KV, D), jnp.bfloat16)
+            vc = jax.random.normal(kv, (B, Smax, KV, D), jnp.bfloat16)
+            cl = jnp.asarray(Smax - 1, jnp.int32)
+            if decode_attention(qd, kc, vc, cl) is None:
+                # fallback predicate tripped: don't bank a no-op timing
+                print(json.dumps({"op": "decode_attention",
+                                  "error": "kernel ineligible (fallback)",
+                                  "smoke": smoke}), flush=True)
+            else:
+                f = jax.jit(
+                    lambda q, k, v, c: decode_attention(q, k, v, c)
+                )
+                sec = _time(f, qd, kc, vc, cl)
+                # decode is HBM-bound: kv stream bytes / time is the
+                # honest number
+                kv_bytes = 2 * B * Smax * KV * D * 2
+                gbps = kv_bytes / sec / 1e9 if sec > 0 else 0.0
+                print(json.dumps({
+                    "op": "decode_attention", "ms": round(sec * 1e3, 3),
+                    "kv_gbps": round(gbps, 1), "smax": Smax,
+                    "smoke": smoke,
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

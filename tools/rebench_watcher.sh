@@ -21,7 +21,9 @@ DONE_TILE=perf/.rebench_tile_done
 DONE_INT8=perf/.rebench_decode_int8_done
 DONE_FADAM=perf/.rebench_fused_adam_done
 DONE_SEQ8K=perf/.rebench_seq8k_done
+DONE_KBENCH=perf/.rebench_kernels_done
 tile_fails=0
+kbench_fails=0
 moe_e_fails=0
 moe_g_fails=0
 int8_fails=0
@@ -85,6 +87,22 @@ for i in $(seq 1 "$ATTEMPTS"); do
             moe_g_fails=$((moe_g_fails + 1))
             [ "$moe_g_fails" -ge 2 ] \
                 && echo "[rebench] moe gather pruned" && touch "$DONE_MOE_G"
+        fi
+    fi
+    # per-kernel MXU-efficiency baselines (flash fwd/bwd, rmsnorm, decode)
+    # at the default and the sweep-winner tiles — the r5 tuning baseline
+    if [ ! -f "$DONE_KBENCH" ]; then
+        { timeout 900 python tools/bench_kernels.py \
+            && timeout 900 python tools/bench_kernels.py --bq 512 --bk 1024; } \
+            > perf/bench_kernels.json 2>&1
+        rc=$?
+        echo "[rebench] kernel bench rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_KBENCH"
+        else
+            kbench_fails=$((kbench_fails + 1))
+            [ "$kbench_fails" -ge 2 ] \
+                && echo "[rebench] kernel bench pruned" && touch "$DONE_KBENCH"
         fi
     fi
     # long-context leg: seq 8192 at the same 16384 tokens/step (flash DMA
@@ -155,7 +173,7 @@ for i in $(seq 1 "$ATTEMPTS"); do
     if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE_E" ] \
         && [ -f "$DONE_MOE_G" ] && [ -f "$DONE_INT8" ] \
         && [ -f "$DONE_FADAM" ] && [ -f "$DONE_SEQ8K" ] \
-        && [ -f "$DONE_TILE" ]; then
+        && [ -f "$DONE_KBENCH" ] && [ -f "$DONE_TILE" ]; then
         echo "[rebench] done $(date -u +%FT%TZ)"
         exit 0
     fi
