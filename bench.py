@@ -58,6 +58,13 @@ def enable_compile_cache():
         pass
 
 
+def model_tag() -> str:
+    """BENCH_MODEL selects the bench model size: "410m" (default) or
+    "1b" — the ZeRO-3 + pinned-host-offload leg BASELINE.json's "7B-70B"
+    metric line demands at least one datapoint toward."""
+    return os.environ.get("BENCH_MODEL", "410m").lower()
+
+
 def bench_dims(smoke: bool):
     """(B, S) of the bench batch, computable without touching jax — the
     sweep parent needs the grid geometry while the model only ever
@@ -82,17 +89,33 @@ def bench_model_and_data(smoke: bool):
     from deepspeed_tpu.models import llama
 
     B, S = bench_dims(smoke)
-    model = llama(
-        "llama-tiny",
-        vocab_size=1024 if smoke else 32768,
-        max_seq_len=S,
-        hidden_size=128 if smoke else 1024,
-        num_layers=2 if smoke else 24,
-        num_heads=8,
-        num_kv_heads=4,
-        head_dim=16 if smoke else 128,
-        intermediate_size=512 if smoke else 4096,
-    )
+    if not smoke and model_tag() == "1b":
+        # ~1.4B params: bf16 weights+grads ~5.6 GB fit the 16 GB v5e, the
+        # fp32 adam m/v + master (~17 GB) do NOT — precisely the shape
+        # ZeRO-3 + pinned_host optimizer offload exists for
+        model = llama(
+            "llama-1b",
+            vocab_size=32768,
+            max_seq_len=S,
+            hidden_size=2048,
+            num_layers=22,
+            num_heads=16,
+            num_kv_heads=8,
+            head_dim=128,
+            intermediate_size=8192,
+        )
+    else:
+        model = llama(
+            "llama-tiny",
+            vocab_size=1024 if smoke else 32768,
+            max_seq_len=S,
+            hidden_size=128 if smoke else 1024,
+            num_layers=2 if smoke else 24,
+            num_heads=8,
+            num_kv_heads=4,
+            head_dim=16 if smoke else 128,
+            intermediate_size=512 if smoke else 4096,
+        )
     data = {
         "input_ids": np.random.RandomState(0).randint(
             0, model.config.vocab_size, size=(B, S)
@@ -156,11 +179,33 @@ def main():
     mb_half = max(mb_full // 2, 1)
     kernels_on = {}  # engine defaults (flash + fused CE auto-on for TPU)
     conservative = {"fused_ce": False}  # plain dense-logits loss path
-    seed = None if (policy or smoke) else load_sweep_seed(dp, B)
-    ladder = (
-        [(policy, mb_full, kernels_on)]
-        if policy
-        else [
+    big = not smoke and model_tag() == "1b"
+    zero_section = (
+        {"stage": 3, "offload_optimizer": {"device": "cpu"}}
+        if big
+        else {"stage": 0}
+    )
+    seed = None if (policy or smoke or big) else load_sweep_seed(dp, B)
+    if big:
+        # fp32 optimizer state lives in pinned host memory; remat is
+        # mandatory and micro shrinks until weights+grads+activations fit.
+        # The 410m sweep's winning flash tiles transfer (same S, hd).
+        tiles = {"flash_block_q": 512, "flash_block_k": 1024}
+        ladder = (
+            [(policy, mb_half, tiles)]
+            if policy
+            else [
+                ("dots_flash", mb_half, tiles),
+                ("dots_flash", max(mb_full // 4, 1), tiles),
+                ("full", max(mb_full // 4, 1), tiles),
+                ("full", 1, kernels_on),
+                ("full", 1, conservative),
+            ]
+        )
+    elif policy:
+        ladder = [(policy, mb_full, kernels_on)]
+    else:
+        ladder = [
             ("none", mb_full, kernels_on), ("dots_flash", mb_full, kernels_on),
             ("dots_flash", mb_half, kernels_on),
             ("dots_saveable", mb_half, kernels_on),
@@ -170,7 +215,6 @@ def main():
             ("attn_mlp", mb_half, kernels_on), ("full", mb_half, kernels_on),
             ("full", mb_half, conservative),
         ]
-    )
     if seed is not None:
         ladder = [seed] + [r for r in ladder if r[:2] != seed[:2]]
     if os.environ.get("BENCH_FUSED_ADAM"):
@@ -189,7 +233,7 @@ def main():
                     "train_micro_batch_size_per_gpu": micro,
                     "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
                     "bf16": {"enabled": True},
-                    "zero_optimization": {"stage": 0},
+                    "zero_optimization": zero_section,
                     "gradient_clipping": 1.0,
                     "steps_per_print": 1000,
                     "activation_checkpointing": {"policy": pol},
@@ -250,68 +294,128 @@ def main():
     model_flops = 3 * fwd_flops_per_token * tokens_per_step
     mfu = model_flops / dt / peak_flops_per_chip()
 
-    priors = []
-    for prior in sorted(
-        f
-        for f in os.listdir(REPO_DIR)
-        if f.startswith("BENCH_r") and f.endswith(".json")
-    ):
-        try:
-            with open(os.path.join(REPO_DIR, prior)) as fh:
-                text = fh.read()
-
-            def take(rec):
-                if isinstance(rec, dict):
-                    v = rec.get("value") or (rec.get("parsed") or {}).get("value")
-                    if isinstance(v, (int, float)):
-                        priors.append(float(v))
-
-            # driver records are one JSON object per file, but may be
-            # wrapped in a run log — scan line-wise, then fall back to a
-            # whole-file parse (pretty-printed JSON) if no line matched
-            found_before = len(priors)
-            for line in text.splitlines():
-                line = line.strip()
-                if line:
-                    try:
-                        take(json.loads(line))
-                    except ValueError:
-                        pass
-            if len(priors) == found_before:
-                take(json.loads(text))
-        except Exception:
-            pass
-    baseline = max(priors) if priors else None
+    # ---- one ratchet, one record file (VERDICT r4 #9) -----------------------
+    # RECORDS.json (committed) holds the best *bench-verified* number per
+    # comparability class; perf/history.jsonl (append-only) keeps every raw
+    # measurement. The ratchet compares only within the class — seq8192 or
+    # the 1b leg never report phantom regressions against the seq2048
+    # record, and a sweep-only number can never become the baseline.
+    cls = f"train_{model_tag()}_seq{S}" + (
+        "_fadam" if os.environ.get("BENCH_FUSED_ADAM") else ""
+    )
+    baseline = None
+    if not smoke:
+        baseline = best_prior(cls)
     vs = tok_per_sec / baseline if baseline else 1.0
-    if os.environ.get("BENCH_SEQ") and S != 2048:
-        # the BENCH_r*.json priors were recorded at seq2048; tokens/sec at
-        # a different sequence length is not comparable (attention grows
-        # quadratically) — don't report a phantom regression
-        vs = 1.0
     if smoke:
         # CPU validation run: TPU-peak MFU and real-TPU priors are
         # meaningless here — don't feed a ratchet false regressions
         vs, mfu = 1.0, 0.0
 
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "SMOKE-MODE bench validation (not a perf record)"
-                    if smoke
-                    else ("llama-410M train tokens/sec/chip "
-                          f"(bf16, seq{S}, MFU attached)")
-                ),
-                "value": round(tok_per_sec, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(vs, 4),
-                "mfu": round(mfu, 4),
-                "step_time_s": round(dt, 4),
-                "params_m": round(n_params / 1e6, 1),
-                "remat_policy": policy,
-            }
-        )
-    )
+    result = {
+        "metric": (
+            "SMOKE-MODE bench validation (not a perf record)"
+            if smoke
+            else (f"llama-{model_tag()} train tokens/sec/chip "
+                  f"(bf16, seq{S}, MFU attached)")
+        ),
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+        "mfu": round(mfu, 4),
+        "step_time_s": round(dt, 4),
+        "params_m": round(n_params / 1e6, 1),
+        "remat_policy": policy,
+    }
+    if not smoke:
+        note = bank_record(cls, result)
+        if note:
+            result["record_note"] = note
+    print(json.dumps(result))
+
+
+def best_prior(cls: str) -> float | None:
+    """The ratchet baseline for a comparability class: the best verified
+    record in RECORDS.json, plus (for the headline class only) the
+    driver-recorded BENCH_r*.json priors from earlier rounds."""
+    priors = []
+    try:
+        with open(os.path.join(REPO_DIR, "RECORDS.json")) as f:
+            rec = (json.load(f) or {}).get(cls) or {}
+        if isinstance(rec.get("value"), (int, float)):
+            priors.append(float(rec["value"]))
+    except Exception:
+        pass
+    if cls == "train_410m_seq2048":
+        for prior in sorted(
+            f for f in os.listdir(REPO_DIR)
+            if f.startswith("BENCH_r") and f.endswith(".json")
+        ):
+            try:
+                with open(os.path.join(REPO_DIR, prior)) as fh:
+                    text = fh.read()
+
+                def take(rec):
+                    if isinstance(rec, dict):
+                        v = rec.get("value") or (
+                            rec.get("parsed") or {}).get("value")
+                        if isinstance(v, (int, float)):
+                            priors.append(float(v))
+
+                # driver records are one JSON object per file, but may be
+                # wrapped in a run log — scan line-wise, then fall back to
+                # a whole-file parse if no line matched
+                found_before = len(priors)
+                for line in text.splitlines():
+                    line = line.strip()
+                    if line:
+                        try:
+                            take(json.loads(line))
+                        except ValueError:
+                            pass
+                if len(priors) == found_before:
+                    take(json.loads(text))
+            except Exception:
+                pass
+    return max(priors) if priors else None
+
+
+def bank_record(cls: str, result: dict) -> str:
+    """Append the raw measurement to perf/history.jsonl and promote it to
+    RECORDS.json only if it beats the class's standing verified record —
+    a slower re-run can never silently displace a better number, and the
+    displacement (either way) is logged in the history."""
+    os.makedirs(os.path.join(REPO_DIR, "perf"), exist_ok=True)
+    entry = {**result, "ts": round(time.time(), 1), "class": cls,
+             "source": "bench"}
+    with open(os.path.join(REPO_DIR, "perf", "history.jsonl"), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    path = os.path.join(REPO_DIR, "RECORDS.json")
+    try:
+        with open(path) as f:
+            records = json.load(f) or {}
+    except Exception:
+        records = {}
+    prev = records.get(cls) or {}
+    prev_v = prev.get("value")
+    if isinstance(prev_v, (int, float)) and result["value"] <= prev_v:
+        return (f"prior verified record stands: {prev_v} tok/s "
+                f"({prev.get('remat_policy', '?')}, ts {prev.get('ts', '?')})")
+    records[cls] = {
+        k: result[k]
+        for k in ("value", "unit", "mfu", "step_time_s", "params_m",
+                  "remat_policy")
+        if k in result
+    }
+    records[cls].update(ts=entry["ts"], verified=True, source="bench")
+    # atomic replace: a kill mid-write must not truncate the record file
+    # (a parse failure would silently reset every class's ratchet)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(records, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return ""
 
 
 if __name__ == "__main__":

@@ -142,6 +142,9 @@ int64_t dsidx_seq_len(void *vh, int64_t i) {
 int dsidx_fill_batch(void *vh, const int64_t *idx, int32_t n, int64_t seqlen,
                      int64_t start, int32_t pad_id, int32_t *out) {
   Handle *h = static_cast<Handle *>(vh);
+  // a negative start would underflow s0 + start below; callers get -1,
+  // matching the bad-index contract
+  if (start < 0 || seqlen < 0) return -1;
   for (int32_t k = 0; k < n; ++k) {
     int64_t i = idx[k];
     if (i < 0 || static_cast<uint64_t>(i) >= h->count) return -1;

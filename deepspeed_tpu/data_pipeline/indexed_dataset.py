@@ -1,11 +1,15 @@
 """Indexed binary token dataset: the pretraining-data backbone.
 
-Parity: Megatron-style .bin/.idx indexed datasets, which the reference's
-data pipeline consumes (megatron/data/indexed_dataset.py MMapIndexedDataset
-+ its C gather backend; deepspeed/runtime/data_pipeline reads them for
-curriculum/analysis). Tokens live in one flat .bin; the .idx carries
-cumulative offsets, so a dataset of millions of variable-length documents
-costs two mmaps and zero Python objects per document.
+Parity: fills the role of Megatron-style .bin/.idx indexed datasets in the
+reference's data pipeline (megatron/data/indexed_dataset.py
+MMapIndexedDataset + its C gather backend; deepspeed/runtime/data_pipeline
+reads them for curriculum/analysis). The on-disk layout is this package's
+OWN format (magic ``DSTPUIDX``; write with IndexedDatasetBuilder, read
+back with MMapIndexedDataset) — it is NOT byte-compatible with
+Megatron/DeepSpeed ``MMIDIDX`` files; pointing this reader at one raises
+"bad magic". Tokens live in one flat .bin; the .idx carries cumulative
+offsets, so a dataset of millions of variable-length documents costs two
+mmaps and zero Python objects per document.
 
 The gather hot path (a batch of documents → one padded [n, seqlen] int32
 array) runs in C++ (csrc/data/indexed_reader.cpp, built on first use like
@@ -107,11 +111,23 @@ class IndexedDatasetBuilder:
         self._offsets.append(self._offsets[-1] + len(arr))
 
     def _upgrade_to_i32(self) -> None:
+        # stream the u16 -> i32 rewrite in bounded chunks: the .bin may be
+        # many GB by the time the first >65535 token arrives
         self._bin.close()
-        old = np.fromfile(self.prefix + ".bin", dtype=np.uint16)
+        old_path = self.prefix + ".bin"
+        tmp_path = old_path + ".i32tmp"
+        chunk = 1 << 22  # 4M tokens = 8 MiB read / 16 MiB write per step
+        with open(old_path, "rb") as src, open(tmp_path, "wb") as dst:
+            while True:
+                buf = src.read(chunk * 2)
+                if not buf:
+                    break
+                dst.write(
+                    np.frombuffer(buf, np.uint16).astype(np.int32).tobytes()
+                )
+        os.replace(tmp_path, old_path)
         self._dtype = np.int32
-        old.astype(np.int32).tofile(self.prefix + ".bin")
-        self._bin = open(self.prefix + ".bin", "ab")
+        self._bin = open(old_path, "ab")
 
     def finalize(self) -> None:
         self._bin.close()
@@ -207,6 +223,10 @@ class MMapIndexedDataset:
                   pad_id: Optional[int] = None) -> np.ndarray:
         """[n, seqlen] int32: tokens [start, start+seqlen) of each doc,
         truncated at the doc's end, padded with pad_id."""
+        if start < 0 or seqlen < 0:
+            # the C++ side rejects these too; validating here keeps both
+            # backends on one contract (no Python negative-slice semantics)
+            raise ValueError(f"start/seqlen must be >= 0, got {start}/{seqlen}")
         idx = np.ascontiguousarray(indices, np.int64)
         pad = self.pad_id if pad_id is None else int(pad_id)
         out = np.empty((len(idx), seqlen), np.int32)

@@ -189,11 +189,21 @@ class InferenceEngine:
             )
             params = jax.device_put(params, shardings)
         self.params = params
-        # speculative decoding (greedy, B=1): a small draft model proposes,
-        # the main model verifies a whole window per forward
+        # speculative decoding (greedy, B=1): a draft proposes, the main
+        # model verifies a whole window per forward. draft_model="ngram"
+        # self-drafts by n-gram lookup in the token buffer (prompt-lookup
+        # decoding) — zero extra parameters, zero extra HBM streams
         self.draft_model = draft_model
         self.draft_params = None
-        if draft_model is not None:
+        self.spec_ngram_n = 3  # context length for the "ngram" draft
+        if isinstance(draft_model, str):
+            if draft_model != "ngram":
+                raise ValueError(
+                    f"draft_model={draft_model!r}: the only string draft is "
+                    '"ngram" (prompt-lookup self-drafting); otherwise pass '
+                    "a model"
+                )
+        elif draft_model is not None:
             if draft_model.config.vocab_size != self.config.vocab_size:
                 raise ValueError(
                     "draft model must share the main model's vocabulary "
@@ -271,10 +281,38 @@ class InferenceEngine:
         accepted position, so entries from rejected drafts are always
         overwritten before any later query can attend them (windows are
         contiguous and advance by >= 1 per round).
+
+        draft_model="ngram" replaces the draft forward with a vectorized
+        n-gram lookup over the token buffer (prompt-lookup decoding): the
+        most recent earlier occurrence of the last n tokens supplies the
+        proposed continuation, falling back to the buffer's stale verifier
+        predictions past ``pos``. Proposal cost is a few VPU ops — and
+        since batch-1 decode is HBM-bound, verifying k tokens streams the
+        same weight bytes as decoding one, so every accepted draft token
+        is nearly free throughput.
         """
         cfg = self.config
-        dcfg = self.draft_model.config
+        ngram = isinstance(self.draft_model, str)
+        m = int(self.spec_ngram_n)
+        dcfg = None if ngram else self.draft_model.config
         total_alloc = total_len + k  # margin so last-round writes stay in-bounds
+
+        def ngram_propose(tokens_buf, pos):
+            """[1, k-1] proposed tokens for positions pos+1..pos+k-1."""
+            buf = tokens_buf[0]
+            idx = jnp.arange(buf.shape[0])
+            # context-end candidates e < pos whose trailing m tokens match
+            # the buffer's trailing m tokens at pos (roll is safe: e >= m-1
+            # >= t keeps every compared index in-bounds, no wraparound)
+            match = (idx >= m - 1) & (idx < pos)
+            for t in range(m):
+                match &= jnp.roll(buf, t) == jnp.take(buf, pos - t)
+            e = jnp.max(jnp.where(match, idx, -1))
+            # fallback: past-pos entries hold the previous rejected
+            # window's verifier predictions — free, plausible proposals
+            start = jnp.where(e >= 0, e + 1, pos + 1)
+            cont = lax.dynamic_slice(buf, (start,), (k - 1,))
+            return cont[None, :].astype(jnp.int32)
 
         def spec_generate(params, dparams, tokens_buf, eos_id):
             main_cache = init_cache(
@@ -282,8 +320,10 @@ class InferenceEngine:
                 self.kv_cache_storage_dtype,
                 quantized=self.kv_cache_quantized,
             )
-            draft_cache = init_cache(dcfg, 1, _align_cache(total_alloc),
-                                     self.dtype)
+            draft_cache = (
+                jnp.zeros((), jnp.int32) if ngram
+                else init_cache(dcfg, 1, _align_cache(total_alloc), self.dtype)
+            )
             prompt = tokens_buf[:, :prompt_len]
             logits, main_cache = forward_with_cache(
                 cfg, materialize_packed(params, self.dtype), prompt,
@@ -293,9 +333,10 @@ class InferenceEngine:
             tokens_buf = lax.dynamic_update_slice(
                 tokens_buf, n0[:, None], (0, prompt_len)
             )
-            _, draft_cache = forward_with_cache(
-                dcfg, dparams, prompt, draft_cache, 0, dtype=self.dtype
-            )
+            if not ngram:
+                _, draft_cache = forward_with_cache(
+                    dcfg, dparams, prompt, draft_cache, 0, dtype=self.dtype
+                )
 
             def cond(state):
                 _, _, _, pos, done, _ = state
@@ -303,30 +344,40 @@ class InferenceEngine:
 
             def body(state):
                 tokens_buf, main_cache, draft_cache, pos, done, rounds = state
-                # --- draft k-1 tokens autoregressively ------------------
-                # the loop runs k steps (one past the last proposal): the
-                # extra step's token is discarded but its forward writes the
-                # draft-cache row at pos+k-1, which a fully-accepting round
-                # (adv = k) would otherwise leave as zeros forever —
-                # collapsing acceptance for the rest of the generation
                 start_tok = lax.dynamic_slice(tokens_buf, (0, pos), (1, 1))
-                cand0 = jnp.zeros((1, k + 1), jnp.int32)
-                cand0 = lax.dynamic_update_slice(cand0, start_tok, (0, 0))
-
-                def dstep(i, carry):
-                    cand, dcache = carry
-                    tok = lax.dynamic_slice(cand, (0, i), (1, 1))
-                    dlog, dcache = forward_with_cache(
-                        dcfg, dparams, tok, dcache, pos + i, dtype=self.dtype
+                if ngram:
+                    cand = jnp.concatenate(
+                        [start_tok.astype(jnp.int32),
+                         ngram_propose(tokens_buf, pos)], axis=1
                     )
-                    nxt = jnp.argmax(dlog[:, -1], axis=-1).astype(jnp.int32)
-                    cand = lax.dynamic_update_slice(cand, nxt[:, None], (0, i + 1))
-                    return cand, dcache
+                else:
+                    # --- draft k-1 tokens autoregressively --------------
+                    # the loop runs k steps (one past the last proposal):
+                    # the extra step's token is discarded but its forward
+                    # writes the draft-cache row at pos+k-1, which a fully-
+                    # accepting round (adv = k) would otherwise leave as
+                    # zeros forever — collapsing acceptance for the rest
+                    # of the generation
+                    cand0 = jnp.zeros((1, k + 1), jnp.int32)
+                    cand0 = lax.dynamic_update_slice(cand0, start_tok, (0, 0))
 
-                cand, draft_cache = lax.fori_loop(
-                    0, k, dstep, (cand0, draft_cache)
-                )
-                cand = cand[:, :k]  # the k-th drafted token is never proposed
+                    def dstep(i, carry):
+                        cand, dcache = carry
+                        tok = lax.dynamic_slice(cand, (0, i), (1, 1))
+                        dlog, dcache = forward_with_cache(
+                            dcfg, dparams, tok, dcache, pos + i,
+                            dtype=self.dtype
+                        )
+                        nxt = jnp.argmax(dlog[:, -1], axis=-1).astype(jnp.int32)
+                        cand = lax.dynamic_update_slice(
+                            cand, nxt[:, None], (0, i + 1)
+                        )
+                        return cand, dcache
+
+                    cand, draft_cache = lax.fori_loop(
+                        0, k, dstep, (cand0, draft_cache)
+                    )
+                    cand = cand[:, :k]  # the k-th draft is never proposed
                 # --- verify the whole window in one main forward --------
                 # in-body materialize: keeps the dequant inside the loop
                 vlog, main_cache = forward_with_cache(

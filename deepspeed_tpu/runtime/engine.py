@@ -92,6 +92,14 @@ def initialize(
 
     cfg = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
 
+    def _mpu_reported(*names):
+        for n in names:
+            fn = getattr(mpu, n, None)
+            if callable(fn):
+                return int(fn())
+        return 1
+
+    mpu_consumed = False
     if topology is None and mpu is not None and not comm.is_initialized():
         # mpu protocol: the reference reads tensor/pipeline sizes off the
         # Megatron mpu. mpu overrides the config's tp/pp; the other mesh
@@ -99,13 +107,8 @@ def initialize(
         # no-mpu branch below, and a pp the config can't run (no pipeline
         # section → no stage layers → TpuEngine) is an error, not a
         # silently replicated mesh axis.
-        def _mpu_size(*names):
-            for n in names:
-                fn = getattr(mpu, n, None)
-                if callable(fn):
-                    return int(fn())
-            return 1
-
+        _mpu_size = _mpu_reported
+        mpu_consumed = True
         mpu_pp = _mpu_size("get_pipe_parallel_world_size",
                            "get_pipeline_model_parallel_world_size")
         if mpu_pp > 1 and cfg.pipeline.stages <= 1:
@@ -143,6 +146,27 @@ def initialize(
             )
     else:
         comm.set_topology(topology)
+
+    if mpu is not None and not mpu_consumed:
+        # mpu arrived too late to shape the mesh (comm already initialized
+        # or an explicit topology was passed); a disagreeing mpu must not
+        # proceed silently — the caller's Megatron groups and this mesh
+        # would split tensors differently
+        mpu_tp = _mpu_reported("get_tensor_model_parallel_world_size",
+                               "get_model_parallel_world_size")
+        mpu_pp = _mpu_reported("get_pipe_parallel_world_size",
+                               "get_pipeline_model_parallel_world_size")
+        top_tp, top_pp = topology.get_dim("tp"), topology.get_dim("pp")
+        if (mpu_tp, mpu_pp) != (top_tp, top_pp):
+            raise ValueError(
+                f"initialize(mpu=...): mpu reports tp={mpu_tp} pp={mpu_pp} "
+                f"but the active topology has tp={top_tp} pp={top_pp}; "
+                "initialize comm from the mpu (or pass a matching topology)"
+            )
+        log_dist(
+            "initialize(mpu=...): mesh already initialized; verified mpu "
+            f"sizes match (tp={top_tp}, pp={top_pp})"
+        )
 
     cfg.resolve_batch_sizes(topology.data_shard_size)
 
@@ -1418,11 +1442,19 @@ class TpuEngine:
 
         host = jax.tree.map(_to_host, self.state.params)
         fam = str(getattr(self.model.config, "name", "")).split("-")[0].lower()
-        try:
+        hf_families = ("llama", "mistral", "gpt2", "bloom", "mixtral")
+        if fam in hf_families:
+            # a recognized family must export HF names; an exporter bug
+            # here should surface, not silently degrade the file
             flat = export_hf_state_dict(host, self.model.config, fam)
-        except Exception:  # unknown family/layout: internal names
+            log_dist(f"save_16bit_model: HF state_dict names ({fam})")
+        else:
             flat = dict(zip(_leaf_paths(host),
                             jax.tree_util.tree_leaves(host)))
+            log_dist(
+                f"save_16bit_model: family {fam!r} has no HF exporter; "
+                "writing internal keystr names (same-framework reload only)"
+            )
         flat = {
             k: (np.asarray(v).astype(jnp.bfloat16)  # ml_dtypes scalar type
                 if np.issubdtype(np.asarray(v).dtype, np.floating)

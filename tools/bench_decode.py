@@ -30,8 +30,13 @@ def main():
                     help="KV cache storage (int8: quantized, half HBM)")
     ap.add_argument("--new-tokens", type=int, default=128)
     ap.add_argument("--speculative", action="store_true",
-                    help="attach a 2-layer draft of the same family "
-                    "(greedy speculative decoding; token-exact output)")
+                    help="greedy speculative decoding (token-exact output); "
+                    "--draft picks the proposer")
+    ap.add_argument("--draft", default="ngram", choices=["ngram", "model"],
+                    help="ngram: zero-cost prompt-lookup self-draft "
+                    "(default); model: a 2-layer draft of the same family")
+    ap.add_argument("--draft-tokens", type=int, default=5,
+                    help="proposals per verifier forward")
     args = ap.parse_args()
     if args.new_tokens <= 4 and not os.environ.get("BENCH_SMOKE"):
         ap.error("--new-tokens must be > 4 (4 tokens are folded into the "
@@ -57,7 +62,9 @@ def main():
         intermediate_size=512 if smoke else 4096,
     )
     draft = None
-    if args.speculative:
+    if args.speculative and args.draft == "ngram":
+        draft = "ngram"
+    elif args.speculative:
         # head_dim 128 keeps the DRAFT on the Pallas decode kernel too —
         # the draft loop is the latency-critical part of speculation, and
         # hd=64 silently fell back to the XLA path (r4 decode bench logs)
@@ -86,16 +93,19 @@ def main():
     prompt = np.random.RandomState(0).randint(
         0, model.config.vocab_size, size=(B, prompt_len)
     )
-    engine.generate(prompt, max_new_tokens=4)  # compile prefill + decode
+    gen_kw = (
+        {"num_draft_tokens": args.draft_tokens} if args.speculative else {}
+    )
+    engine.generate(prompt, max_new_tokens=4, **gen_kw)  # compile
 
     t0 = time.perf_counter()
-    engine.generate(prompt, max_new_tokens=4)
+    engine.generate(prompt, max_new_tokens=4, **gen_kw)
     prefill_s = time.perf_counter() - t0  # ~prefill + 4 steps
 
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = engine.generate(prompt, max_new_tokens=new)
+        out = engine.generate(prompt, max_new_tokens=new, **gen_kw)
         np.asarray(out)
         times.append(time.perf_counter() - t0)
     dt = float(np.median(times))  # full generate time
@@ -117,6 +127,9 @@ def main():
                 "kv_cache": args.kv_cache,
                 "kernel_inject": not args.no_inject,
                 "speculative": args.speculative,
+                "draft": args.draft if args.speculative else None,
+                "draft_tokens": (args.draft_tokens if args.speculative
+                                 else None),
                 "spec_rounds": getattr(engine, "last_spec_rounds", None),
                 "smoke": smoke,
             }

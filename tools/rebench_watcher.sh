@@ -12,7 +12,7 @@
 #
 # Usage: nohup bash tools/rebench_watcher.sh >> perf/rebench_watcher.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
-ATTEMPTS=${ATTEMPTS:-60}
+ATTEMPTS=${ATTEMPTS:-150}
 SLEEP_S=${SLEEP_S:-240}
 DONE_CAMPAIGN=perf/.rebench_campaign_done
 DONE_MOE_E=perf/.rebench_moe_einsum_done
@@ -22,6 +22,8 @@ DONE_INT8=perf/.rebench_decode_int8_done
 DONE_FADAM=perf/.rebench_fused_adam_done
 DONE_SEQ8K=perf/.rebench_seq8k_done
 DONE_KBENCH=perf/.rebench_kernels_done
+DONE_1B=perf/.rebench_1b_done
+DONE_SPEC=perf/.rebench_spec_done
 tile_fails=0
 kbench_fails=0
 moe_e_fails=0
@@ -29,6 +31,8 @@ moe_g_fails=0
 int8_fails=0
 fadam_fails=0
 seq8k_fails=0
+b1_fails=0
+spec_fails=0
 
 pool_up() {
     timeout 120 python -c \
@@ -39,9 +43,8 @@ pool_up() {
 for i in $(seq 1 "$ATTEMPTS"); do
     echo "[rebench] attempt $i/$ATTEMPTS $(date -u +%FT%TZ)"
     if [ ! -f "$DONE_CAMPAIGN" ]; then
-        if [ -s perf/bench.json ]; then
-            cp perf/bench.json "perf/bench.json.bak$i"
-        fi
+        # (no .bak copies: bench.py itself appends every measurement to
+        # perf/history.jsonl and ratchets RECORDS.json)
         # outer guard > worst-case sum of the wrapped stage timeouts
         # (probe 120 + bench 3600 + profile 3600 + report 300); moe/tile
         # run as their own steps below so a failure there can't force
@@ -60,6 +63,38 @@ for i in $(seq 1 "$ATTEMPTS"); do
         echo "[rebench] pool down; retrying in ${SLEEP_S}s"
         sleep "$SLEEP_S"
         continue
+    fi
+    # >=1B-param leg: ZeRO-3 + pinned_host optimizer offload (VERDICT r5
+    # item #2) — banked right after the headline bench so a short window
+    # still captures it
+    if [ ! -f "$DONE_1B" ]; then
+        BENCH_MODEL=1b timeout 3000 python bench.py \
+            > perf/bench_1b.json 2>&1
+        rc=$?
+        echo "[rebench] bench 1b rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_1B"
+        else
+            b1_fails=$((b1_fails + 1))
+            [ "$b1_fails" -ge 2 ] \
+                && echo "[rebench] 1b bench pruned" && touch "$DONE_1B"
+        fi
+    fi
+    # speculative decode with the n-gram/self draft (VERDICT r5 item #5);
+    # gated on the sentinel the builder drops once the draft ships, so a
+    # pool window before the feature exists can't two-strike it away
+    if [ ! -f "$DONE_SPEC" ] && [ -f perf/.spec_ready ]; then
+        timeout 2500 python tools/bench_decode.py --speculative \
+            > perf/decode_spec_ngram.json 2>&1
+        rc=$?
+        echo "[rebench] decode speculative(ngram) rc=$rc"
+        if [ "$rc" -eq 0 ]; then
+            touch "$DONE_SPEC"
+        else
+            spec_fails=$((spec_fails + 1))
+            [ "$spec_fails" -ge 2 ] \
+                && echo "[rebench] spec decode pruned" && touch "$DONE_SPEC"
+        fi
     fi
     # MoE A/B: one flag per dispatch leg so a gather-only failure never
     # re-burns the banked einsum measurement
@@ -170,10 +205,15 @@ for i in $(seq 1 "$ATTEMPTS"); do
             fi
         fi
     fi
+    # spec is only owed once its sentinel exists (the builder drops it when
+    # the ngram draft ships); without the sentinel the leg must not keep an
+    # otherwise-finished watcher polling for hours
     if [ -f "$DONE_CAMPAIGN" ] && [ -f "$DONE_MOE_E" ] \
         && [ -f "$DONE_MOE_G" ] && [ -f "$DONE_INT8" ] \
         && [ -f "$DONE_FADAM" ] && [ -f "$DONE_SEQ8K" ] \
-        && [ -f "$DONE_KBENCH" ] && [ -f "$DONE_TILE" ]; then
+        && [ -f "$DONE_KBENCH" ] && [ -f "$DONE_TILE" ] \
+        && [ -f "$DONE_1B" ] \
+        && { [ -f "$DONE_SPEC" ] || [ ! -f perf/.spec_ready ]; }; then
         echo "[rebench] done $(date -u +%FT%TZ)"
         exit 0
     fi
