@@ -94,7 +94,7 @@ def bench_model_and_data(smoke: bool):
         # fp32 adam m/v + master (~17 GB) do NOT — precisely the shape
         # ZeRO-3 + pinned_host optimizer offload exists for
         model = llama(
-            "llama-1b",
+            "llama3-1b",
             vocab_size=32768,
             max_seq_len=S,
             hidden_size=2048,
@@ -394,8 +394,15 @@ def bank_record(cls: str, result: dict) -> str:
     try:
         with open(path) as f:
             records = json.load(f) or {}
-    except Exception:
+    except FileNotFoundError:
         records = {}
+    except Exception as e:
+        # an UNREADABLE file must not become an empty dict: the rewrite
+        # below would wipe every other class's verified record. Preserve
+        # the evidence and refuse the ratchet update (the measurement is
+        # still in history.jsonl).
+        return (f"RECORDS.json unreadable ({e}); record NOT banked — "
+                "repair the file (raw measurement kept in history.jsonl)")
     prev = records.get(cls) or {}
     prev_v = prev.get("value")
     if isinstance(prev_v, (int, float)) and result["value"] <= prev_v:

@@ -175,6 +175,7 @@ class PipelineConfig:
     seed_layers: bool = False
     activation_checkpoint_interval: int = 0
     pipe_schedule: str = "1f1b"  # 1f1b | gpipe (memory policy; grads identical)
+    tick_chunk: int = 0  # 1f1b ckpt-chunk size in ticks; 0 = auto (~sqrt)
 
 
 @dataclass
@@ -535,6 +536,11 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError("gradient_clipping must be >= 0")
         if self.pipeline.stages < 1:
             raise DeepSpeedConfigError("pipeline.stages must be >= 1")
+        if self.pipeline.pipe_schedule not in ("1f1b", "gpipe"):
+            raise DeepSpeedConfigError(
+                "pipeline.pipe_schedule must be 1f1b or gpipe, got "
+                f"{self.pipeline.pipe_schedule!r}"
+            )
         if self.zero_config.stage >= 2 and self.pipeline.stages > 1:
             # reference: PipelineEngine asserts ZeRO-2/3 unsupported with pipeline
             raise DeepSpeedConfigError(

@@ -31,7 +31,9 @@ import contextlib
 from ..comm.topology import MeshTopology, ParallelDims
 from ..models.decoding import forward_with_cache, init_cache
 from ..models.sharding import use_topology
-from ..ops.quantizer import (materialize_packed, pack_quantize_blockwise,
+from ..ops.quantizer import (PackedWeight, materialize_packed,
+                             pack_quantize_blockwise,
+                             packed_partition_specs, packed_sharding_ok,
                              quantize_dequantize)
 from ..utils.logging import log_dist
 
@@ -180,11 +182,23 @@ class InferenceEngine:
         )
         params = jax.tree.map(cast, params)
         if quantize_bits:
-            params = self._quantize_weights(params, quantize_bits)
+            params = self._quantize_weights(params, quantize_bits, tp_specs)
         if tp_specs is not None and topology.world_size > 1:
+            mesh = topology.mesh
+
+            def to_sharding(spec, leaf):
+                if isinstance(leaf, PackedWeight):
+                    qs, ss = packed_partition_specs(spec, len(leaf.shape))
+                    return PackedWeight(
+                        NamedSharding(mesh, qs), NamedSharding(mesh, ss),
+                        leaf.shape, leaf.bits, leaf.dtype, leaf.nibbles,
+                    )
+                return NamedSharding(mesh, spec)
+
             shardings = jax.tree.map(
-                lambda s: NamedSharding(topology.mesh, s),
+                to_sharding,
                 tp_specs,
+                params,
                 is_leaf=lambda x: isinstance(x, P),
             )
             params = jax.device_put(params, shardings)
@@ -225,28 +239,40 @@ class InferenceEngine:
             f"quant={quantize_bits or 'off'}, kernel_inject={kernel_inject}"
         )
 
-    def _quantize_weights(self, params, bits: int):
+    def _quantize_weights(self, params, bits: int, tp_specs=None):
         """Weight-only block quantization of the big matmul weights.
 
-        Single-device: PACKED storage (ops/quantizer.PackedWeight) — HBM
-        holds int8/int4 + scales and the decode loop streams that, with
-        the dequant materialized inside the loop body (materialize_packed)
-        so XLA fuses it into the consuming matmuls instead of hoisting a
-        full-width weight copy. Under tp>1 the partition_specs tree maps
-        one spec per original leaf and cannot shard the packed pair, so
-        the fake-quant roundtrip keeps the old behavior there (numerics
-        identical either way — same q/dq values)."""
+        PACKED storage (ops/quantizer.PackedWeight) — HBM holds int8/int4
+        + scales and the decode loop streams that, with the dequant
+        materialized inside the loop body (materialize_packed) so XLA
+        fuses it into the consuming matmuls instead of hoisting a
+        full-width weight copy. Under tp>1 the packed pair shards along
+        the weight's own partition spec (packed_partition_specs: blocks
+        stay whole — the contraction dim is stored (G, B) and only G
+        shards), so TP serving streams quantized bytes per shard too. A
+        leaf whose block/nibble geometry does not divide over the mesh
+        falls back to the fake-quant roundtrip (numerics identical either
+        way — same q/dq values), logged by name."""
         big = {"wq", "wk", "wv", "wo", "wi", "wg"}
-        packed = self.topology.world_size == 1
+        sharded = tp_specs is not None and self.topology.world_size > 1
 
-        def q(path, leaf):
+        def q(path, leaf, spec=None):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-            if name in big and leaf.ndim >= 2:
-                if packed:
-                    return pack_quantize_blockwise(leaf, block=128, bits=bits)
+            if name not in big or leaf.ndim < 2:
+                return leaf
+            if sharded and not packed_sharding_ok(
+                leaf.shape, spec, self.topology.mesh, block=128, bits=bits
+            ):
+                log_dist(
+                    f"quantize: {name} falls back to fake-quant (packed "
+                    f"geometry {leaf.shape} does not divide over mesh "
+                    f"spec {spec})"
+                )
                 return quantize_dequantize(leaf, block=128, bits=bits)
-            return leaf
+            return pack_quantize_blockwise(leaf, block=128, bits=bits)
 
+        if sharded:
+            return jax.tree_util.tree_map_with_path(q, params, tp_specs)
         return jax.tree_util.tree_map_with_path(q, params)
 
     # -------------------------------------------------------------- forward

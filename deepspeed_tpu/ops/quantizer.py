@@ -129,6 +129,52 @@ def pack_quantize_blockwise(w: jax.Array, block: int = 128,
     return PackedWeight(q, qt.scale, qt.shape, qt.bits, w.dtype, nibbles)
 
 
+def _axis_size(mesh, ax) -> int:
+    """Total mesh extent of one PartitionSpec entry (None / name / tuple)."""
+    if ax is None:
+        return 1
+    names = ax if isinstance(ax, tuple) else (ax,)
+    size = 1
+    for name in names:
+        size *= int(mesh.shape[name])
+    return size
+
+
+def packed_sharding_ok(shape, spec, mesh, block: int = 128,
+                       bits: int = 8) -> bool:
+    """Whether packed storage of a weight with this PartitionSpec shards on
+    ``mesh`` without splitting quantization blocks or nibble pairs.
+
+    The contraction dim d is stored as (G, B) with only G shardable, so the
+    spec's dim -2 extent must divide G; int4 nibble packing halves the
+    column count, so dim -1's extent must divide n//2."""
+    if spec is None:
+        return True
+    d, n = shape[-2], shape[-1]
+    eff_block = block if d % block == 0 else d
+    groups = d // eff_block
+    s = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    ncols = n // 2 if (bits == 4 and n % 2 == 0) else n
+    return (groups % _axis_size(mesh, s[-2]) == 0
+            and ncols % _axis_size(mesh, s[-1]) == 0)
+
+
+def packed_partition_specs(spec, ndim: int):
+    """Expand an original weight's PartitionSpec onto PackedWeight storage.
+
+    qdata is [..., G, B, n] (nibble-packed: n//2) and scale [..., G, 1, n]:
+    both keep the leading axes, shard G with whatever sharded d, leave the
+    in-block axis replicated, and shard columns like the original — so TP
+    serving streams int8/int4 bytes per shard instead of bf16
+    (reference: DeepSpeed-Inference TP + weight-only quantization compose,
+    deepspeed/module_inject + deepspeed/inference quantization)."""
+    from jax.sharding import PartitionSpec as P
+
+    s = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    q = P(*s[:-2], s[-2], None, s[-1])
+    return q, q
+
+
 def materialize_packed(tree, dtype=None):
     """Dequantize every PackedWeight leaf; plain arrays pass through.
 

@@ -157,10 +157,19 @@ def initialize(
         mpu_pp = _mpu_reported("get_pipe_parallel_world_size",
                                "get_pipeline_model_parallel_world_size")
         top_tp, top_pp = topology.get_dim("tp"), topology.get_dim("pp")
-        if (mpu_tp, mpu_pp) != (top_tp, top_pp):
+        # same convention as the consume branch: an mpu size of 1 (incl.
+        # absent getters) defers to the config/topology — only a size the
+        # mpu actively reports as parallel can conflict
+        mismatch = [
+            f"{name} {got} != {have}"
+            for name, got, have in (("tp", mpu_tp, top_tp),
+                                    ("pp", mpu_pp, top_pp))
+            if got > 1 and got != have
+        ]
+        if mismatch:
             raise ValueError(
-                f"initialize(mpu=...): mpu reports tp={mpu_tp} pp={mpu_pp} "
-                f"but the active topology has tp={top_tp} pp={top_pp}; "
+                f"initialize(mpu=...): mpu reports {', '.join(mismatch)} "
+                f"vs the active topology (tp={top_tp}, pp={top_pp}); "
                 "initialize comm from the mpu (or pass a matching topology)"
             )
         log_dist(

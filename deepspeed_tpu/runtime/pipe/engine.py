@@ -31,6 +31,8 @@ class PipelineEngine(TpuEngine):
                 activation_checkpoint_interval=(
                     config.pipeline.activation_checkpoint_interval
                 ),
+                pipe_schedule=config.pipeline.pipe_schedule,
+                tick_chunk=config.pipeline.tick_chunk,
             )
         if topology.pp_size > 1 and config.gradient_accumulation_steps < topology.pp_size:
             from ...utils.logging import log_dist

@@ -124,6 +124,8 @@ class PipelineModule:
         partition_method: str = "parameters",
         activation_checkpoint_interval: int = 0,
         loss_fn: Optional[Callable] = None,
+        pipe_schedule: str = "1f1b",
+        tick_chunk: int = 0,
     ):
         if model is None and layers is None:
             raise ValueError("PipelineModule needs model= or layers=")
@@ -143,6 +145,8 @@ class PipelineModule:
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.custom_loss_fn = loss_fn
+        self.pipe_schedule = pipe_schedule
+        self.tick_chunk = tick_chunk
         L = self.config.num_layers
         if num_stages > 1 and L % num_stages != 0:
             raise ValueError(
@@ -231,10 +235,23 @@ class PipelineModule:
             masked_ce,
         )
 
+        # 1f1b (default): checkpoint the tick scan in chunks so the stash
+        # stays O(T/C + C) boundary activations — the 1F1B memory bound —
+        # instead of grad-of-scan's O(M) (measured: tools/pipe_memory.py).
+        # gpipe: keep every tick residual (faster backward, O(M) memory).
+        # pipeline.tick_chunk pins the chunk size by hand (0 = auto).
+        tick_chunk = None
+        if self.pipe_schedule == "1f1b" and topology.pp_size > 1:
+            ticks = M + topology.pp_size - 1
+            tick_chunk = (
+                int(self.tick_chunk)
+                if self.tick_chunk > 0
+                else max(topology.pp_size, int(round((ticks / 2) ** 0.5)))
+            )
         x = embed_tokens(cfg, params, input_ids, positions, dtype)  # [M,mb,S,D]
         y, aux = pipelined_stack(
             cfg, cast(params["layers"]), x, positions, batch.get("segment_ids"),
-            topology, train, rng, remat_policy,
+            topology, train, rng, remat_policy, tick_chunk=tick_chunk,
         )
         y = _norm(cfg, cast(params["final_norm"]), y)
         logits = lm_head_logits(cfg, params, y)

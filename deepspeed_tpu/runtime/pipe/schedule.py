@@ -40,12 +40,22 @@ def pipelined_stack(
     train: bool,
     rng: Optional[jax.Array] = None,
     remat_policy: Optional[str] = None,
+    tick_chunk: Optional[int] = None,
 ):
     """Run the block stack as a pp-stage pipeline over microbatches.
 
     layers: stacked block params [L, ...] (dim 0 sharded over pp).
     x: embedded microbatch stream [M, mb, S, D]; positions: [M, mb, S];
     segment_ids: [M, mb, S] or None. Returns (y [M, mb, S, D], moe_aux_mean).
+
+    tick_chunk: checkpoint the schedule in chunks of this many ticks —
+    grad-of-scan otherwise stashes one residual set per tick, i.e.
+    O(num_microbatches) activations (measured: tools/pipe_memory.py),
+    where the reference's 1F1B holds at most pp in-flight stashes
+    (deepspeed/runtime/pipe/engine.py). Chunking stores only chunk-boundary
+    carries and recomputes one chunk at a time during backward: peak stash
+    is O(T/C + C) boundary activations (T = M + pp - 1) at ~2x forward
+    compute — the scan-schedule equivalent of 1F1B's memory bound.
     """
     n_stages = topo.pp_size
     M = x.shape[0]
@@ -70,11 +80,21 @@ def pipelined_stack(
 
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
+    ticks = M + n_stages - 1
+    chunk = 0
+    if tick_chunk:
+        chunk = min(int(tick_chunk), ticks)
+    padded_ticks = (
+        ((ticks + chunk - 1) // chunk) * chunk if chunk else ticks
+    )
+
     def body(local_layers, x_stream, pos_stream, seg_stream):
         stage = lax.axis_index("pp")
 
         def pad_stream(s):
-            return jnp.pad(s, [(0, n_stages - 1)] + [(0, 0)] * (s.ndim - 1))
+            return jnp.pad(
+                s, [(0, padded_ticks - M)] + [(0, 0)] * (s.ndim - 1)
+            )
 
         x_pad, p_pad, s_pad = map(pad_stream, (x_stream, pos_stream, seg_stream))
 
@@ -110,15 +130,31 @@ def pipelined_stack(
             jnp.zeros(seg_stream.shape[1:], seg_stream.dtype),
             jnp.zeros((), jnp.int32),
         )
-        _, (ys, auxs) = lax.scan(tick, carry0, (x_pad, p_pad, s_pad))
+        if chunk:
+            # checkpointed chunks: backward stores only the chunk-boundary
+            # carries (one boundary activation each) and replays one chunk
+            # of ticks at a time; ticks beyond `ticks` are bubble work the
+            # valid-mask zeroes and the output slice drops
+            def run_chunk(carry, inp):
+                return lax.scan(tick, carry, inp)
+
+            xs = tuple(
+                a.reshape(padded_ticks // chunk, chunk, *a.shape[1:])
+                for a in (x_pad, p_pad, s_pad)
+            )
+            _, (ys, auxs) = lax.scan(jax.checkpoint(run_chunk), carry0, xs)
+            ys = ys.reshape(padded_ticks, *ys.shape[2:])
+            auxs = auxs.reshape(padded_ticks)
+        else:
+            _, (ys, auxs) = lax.scan(tick, carry0, (x_pad, p_pad, s_pad))
         # valid outputs live on the last stage at ticks [pp-1, pp-1+M);
         # broadcast them to every stage (head/loss then run replicated-on-pp).
         # fp32 psum: XLA's CPU AllReducePromotion pass crashes on bf16
         # all-reduce under partial-manual shard_map (workaround; fp32 is
         # also the dtype the head consumes anyway).
-        ys = lax.psum(ys[n_stages - 1:].astype(jnp.float32), "pp").astype(
-            x_stream.dtype
-        )
+        ys = lax.psum(
+            ys[n_stages - 1:n_stages - 1 + M].astype(jnp.float32), "pp"
+        ).astype(x_stream.dtype)
         aux_total = lax.psum(jnp.sum(auxs), "pp")  # sum over stages+ticks
         return ys, aux_total / M
 
