@@ -181,7 +181,12 @@ def main():
     conservative = {"fused_ce": False}  # plain dense-logits loss path
     big = not smoke and model_tag() == "1b"
     zero_section = (
-        {"stage": 3, "offload_optimizer": {"device": "cpu"}}
+        # fp32 master params AND adam m/v live in pinned host memory; the
+        # bucketed per-layer update scan (runtime/bucketed_opt.py) streams
+        # one layer of each through HBM per tick — the whole-tree update
+        # OOM'd at 19.6G/15.7G
+        {"stage": 3, "offload_optimizer": {"device": "cpu"},
+         "offload_param": {"device": "cpu"}}
         if big
         else {"stage": 0}
     )

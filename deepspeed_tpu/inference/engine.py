@@ -13,7 +13,10 @@ blocks ("kernel injection") and walks an eager token loop; TPU-native:
 - ``replace_with_kernel_inject`` maps to selecting the Pallas flash
   attention path for prefill (the decode matvec is already MXU-shaped);
 - ``dtype=int8`` / quantize flags use ops/quantizer.py weight-only block
-  quantization (dequant fused into the consuming matmul by XLA).
+  quantization; decode-shaped projections run the Pallas streaming kernel
+  (ops/pallas/quantized_matmul.py) so HBM reads int8/int4 bytes — the
+  dequantize-then-dot alternative materializes full-width weights every
+  decode step (measured 3x slower at 410M).
 """
 
 from __future__ import annotations
@@ -31,8 +34,7 @@ import contextlib
 from ..comm.topology import MeshTopology, ParallelDims
 from ..models.decoding import forward_with_cache, init_cache
 from ..models.sharding import use_topology
-from ..ops.quantizer import (PackedWeight, materialize_packed,
-                             pack_quantize_blockwise,
+from ..ops.quantizer import (PackedWeight, pack_quantize_blockwise,
                              packed_partition_specs, packed_sharding_ok,
                              quantize_dequantize)
 from ..utils.logging import log_dist
@@ -202,6 +204,12 @@ class InferenceEngine:
                 is_leaf=lambda x: isinstance(x, P),
             )
             params = jax.device_put(params, shardings)
+        else:
+            # commit to the serving device: params= may arrive as host
+            # numpy arrays (e.g. exported from a training engine), and an
+            # uncommitted tree re-uploads per jitted call — on a relayed
+            # backend that is tens of seconds of transfer per generate()
+            params = jax.device_put(params, topology.devices[0])
         self.params = params
         # speculative decoding (greedy, B=1): a draft proposes, the main
         # model verifies a whole window per forward. draft_model="ngram"
@@ -243,10 +251,12 @@ class InferenceEngine:
         """Weight-only block quantization of the big matmul weights.
 
         PACKED storage (ops/quantizer.PackedWeight) — HBM holds int8/int4
-        + scales and the decode loop streams that, with the dequant
-        materialized inside the loop body (materialize_packed) so XLA
-        fuses it into the consuming matmuls instead of hoisting a
-        full-width weight copy. Under tp>1 the packed pair shards along
+        + scales; the PackedWeight leaves flow into the jitted decode
+        loop intact, where each projection runs the Pallas streaming
+        kernel (ops/pallas/quantized_matmul.packed_proj) that dequantizes
+        in VMEM — HBM traffic stays at the quantized byte count instead
+        of a per-step full-width dequant temp. Under tp>1 the packed pair
+        shards along
         the weight's own partition spec (packed_partition_specs: blocks
         stay whole — the contraction dim is stored (G, B) and only G
         shards), so TP serving streams quantized bytes per shard too. A
@@ -281,7 +291,7 @@ class InferenceEngine:
         if not hasattr(self, "_jit_forward"):  # jit once, not per call
             self._jit_forward = jax.jit(
                 lambda p, ids: self.model.apply(
-                    materialize_packed(p, self.dtype), ids, dtype=self.dtype
+                    p, ids, dtype=self.dtype
                 )
             )
         with use_topology(self.topology), self._impl_ctx():
@@ -352,7 +362,7 @@ class InferenceEngine:
             )
             prompt = tokens_buf[:, :prompt_len]
             logits, main_cache = forward_with_cache(
-                cfg, materialize_packed(params, self.dtype), prompt,
+                cfg, params, prompt,
                 main_cache, 0, dtype=self.dtype
             )
             n0 = jnp.argmax(logits[:, -1], axis=-1)  # token at position P
@@ -405,9 +415,10 @@ class InferenceEngine:
                     )
                     cand = cand[:, :k]  # the k-th draft is never proposed
                 # --- verify the whole window in one main forward --------
-                # in-body materialize: keeps the dequant inside the loop
+                # packed weights stream via the Pallas kernel (the k-row
+                # verify stays under packed_proj's matvec threshold)
                 vlog, main_cache = forward_with_cache(
-                    cfg, materialize_packed(params, self.dtype), cand,
+                    cfg, params, cand,
                     main_cache, pos, dtype=self.dtype
                 )
                 targets = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [1,k]
@@ -457,7 +468,7 @@ class InferenceEngine:
             )
             prompt = tokens_buf[:, :prompt_len]
             logits, cache = forward_with_cache(
-                cfg, materialize_packed(params, self.dtype), prompt, cache,
+                cfg, params, prompt, cache,
                 0, dtype=self.dtype
             )
             return logits[:, -1], cache
@@ -521,11 +532,10 @@ class InferenceEngine:
             def body(state):
                 tokens_buf, cache, pos, rng, done, seen = state
                 tok = lax.dynamic_slice(tokens_buf, (0, pos), (B, 1))
-                # materialize INSIDE the loop body: the int8->bf16 convert
-                # is size-inflating, so XLA's while-loop LICM keeps it here
-                # and the loop streams quantized weights from HBM
+                # packed weights stay packed: each projection streams
+                # int8/int4 from HBM through the Pallas matvec kernel
                 logits, cache = forward_with_cache(
-                    self.config, materialize_packed(params, self.dtype),
+                    self.config, params,
                     tok, cache, pos, dtype=self.dtype
                 )
                 key, rng = jax.random.split(rng)

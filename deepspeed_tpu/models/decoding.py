@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.pallas.quantized_matmul import packed_proj
 from .sharding import constrain
 from .transformer import (
     Params,
@@ -72,9 +73,9 @@ def _quantize_kv(t: jax.Array):
 def _qkv(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array):
     B, S, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
-    q = jnp.einsum("bsd,dh->bsh", x, p["wq"]).reshape(B, S, nh, hd)
-    k = jnp.einsum("bsd,dh->bsh", x, p["wk"]).reshape(B, S, nkv, hd)
-    v = jnp.einsum("bsd,dh->bsh", x, p["wv"]).reshape(B, S, nkv, hd)
+    q = packed_proj(x, p["wq"]).reshape(B, S, nh, hd)
+    k = packed_proj(x, p["wk"]).reshape(B, S, nkv, hd)
+    v = packed_proj(x, p["wv"]).reshape(B, S, nkv, hd)
     if cfg.use_bias:
         q = q + p["bq"].reshape(1, 1, nh, hd)
         k = k + p["bk"].reshape(1, 1, nkv, hd)
@@ -143,7 +144,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
         )
         out = attn_op(q, k, v, causal=True, alibi_slopes=slopes)
         out = out.reshape(B, S, nh * hd)
-        out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+        out = packed_proj(out, p["wo"])
         if cfg.use_bias:
             out = out + p["bo"]
         return ret(out)
@@ -161,7 +162,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
             )
             if out is not None:
                 out = out.astype(x.dtype).reshape(B, S, nh * hd)
-                out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+                out = packed_proj(out, p["wo"])
                 if cfg.use_bias:
                     out = out + p["bo"]
                 return ret(out)
@@ -190,7 +191,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
     out = out.reshape(B, S, nh * hd)
-    out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+    out = packed_proj(out, p["wo"])
     if cfg.use_bias:
         out = out + p["bo"]
     return ret(out)
@@ -205,9 +206,9 @@ def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Ar
     cached. Returns (fp32 logits [B, S, V], updated cache).
     """
     B, S = input_ids.shape
-    cast = lambda t: jax.tree.map(
-        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
-    )
+    from ..ops.quantizer import cast_floating
+
+    cast = lambda t: cast_floating(t, dtype)
     positions = cache_len + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = cast(params["embed"]["tok"])[input_ids]
     if cfg.pos_embedding == "learned":

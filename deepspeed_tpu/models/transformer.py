@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .sharding import constrain, current_topology
+from ..ops.pallas.quantized_matmul import packed_proj
 
 Params = Dict[str, Any]
 
@@ -235,9 +236,9 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
 
     B, S, d = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
-    q = jnp.einsum("bsd,dh->bsh", x, p["wq"]).reshape(B, S, nh, hd)
-    k = jnp.einsum("bsd,dh->bsh", x, p["wk"]).reshape(B, S, nkv, hd)
-    v = jnp.einsum("bsd,dh->bsh", x, p["wv"]).reshape(B, S, nkv, hd)
+    q = packed_proj(x, p["wq"]).reshape(B, S, nh, hd)
+    k = packed_proj(x, p["wk"]).reshape(B, S, nkv, hd)
+    v = packed_proj(x, p["wv"]).reshape(B, S, nkv, hd)
     if cfg.use_bias:
         q = q + p["bq"].reshape(1, 1, nh, hd)
         k = k + p["bk"].reshape(1, 1, nkv, hd)
@@ -279,7 +280,7 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
             alibi_slopes=slopes,
         )  # [B,S,H,hd]
     out = out.reshape(B, S, nh * hd)
-    out = jnp.einsum("bsh,hd->bsd", out, p["wo"])
+    out = packed_proj(out, p["wo"])
     if cfg.use_bias:
         out = out + p["bo"]
     return out
@@ -298,16 +299,16 @@ def _mlp(cfg: TransformerConfig, p: Params, x: jax.Array, rng: Optional[jax.Arra
         from ..moe.sharded_moe import moe_layer
 
         return moe_layer(cfg, p, x, rng, train)
-    h = jnp.einsum("bsd,df->bsf", x, p["wi"])
+    h = packed_proj(x, p["wi"])
     if cfg.activation == "swiglu":
-        g = jnp.einsum("bsd,df->bsf", x, p["wg"])
+        g = packed_proj(x, p["wg"])
         h = jax.nn.silu(g) * h
     else:
         if cfg.use_bias:
             h = h + p["bi"]
         h = _act(cfg, h)
     h = constrain(h, ("dp", "fsdp"), "sp", "tp")
-    out = jnp.einsum("bsf,fd->bsd", h, p["wo"])
+    out = packed_proj(h, p["wo"])
     if cfg.use_bias and not cfg.activation == "swiglu":
         out = out + p["bo"]
     return out, jnp.zeros((), jnp.float32)
@@ -518,9 +519,9 @@ def apply(cfg: TransformerConfig, params: Params, input_ids: jax.Array, *,
     pos_default = positions is None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    cast = lambda t: jax.tree.map(
-        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t
-    )
+    from ..ops.quantizer import cast_floating
+
+    cast = lambda t: cast_floating(t, dtype)
     x = embed_tokens(cfg, params, input_ids, positions, dtype)
     x, aux = apply_layer_stack(
         cfg, cast(params["layers"]), x, positions, segment_ids, rng, train,

@@ -77,11 +77,14 @@ class PackedWeight:
     per int8 byte, a quarter of the bf16 bytes, and the serving loop
     streams that instead of full-width weights.
 
-    ``materialize_packed`` dequantizes INSIDE the jitted computation; placed
-    inside a decode loop body, the int8→bf16 convert is size-inflating, so
-    XLA's while-loop LICM keeps it in the loop and fuses it into the
-    consuming matmul (reference: DeepSpeed-Inference weight-only int8
-    serving, deepspeed/inference quantization).
+    Serving consumes these nodes through
+    ``ops.pallas.quantized_matmul.packed_proj``: the Pallas kernel
+    dequantizes in VMEM so HBM streams the quantized bytes (the
+    dequantize-in-XLA-loop alternative materializes full-width weights
+    every decode step — measured 3x slower at 410M). ``dequantize`` /
+    ``materialize_packed`` are the XLA-level fallback and export path
+    (reference: DeepSpeed-Inference weight-only int8 serving,
+    deepspeed/inference quantization).
     """
 
     def __init__(self, qdata, scale, shape, bits, dtype, nibbles=False):
@@ -100,13 +103,22 @@ class PackedWeight:
     def dequantize(self):
         q = self.qdata
         if self.nibbles:
-            # low nibble first: arithmetic shifts sign-extend int8, so
-            # (q << 4) >> 4 recovers the signed low value and q >> 4 the
-            # signed high value; interleave back to the original columns
+            # int4 pairs are packed SPLIT-HALF across the block dim: byte
+            # [g, b, n] holds block g (low nibble) and block g + G/2
+            # (high) — so unpacking is a concat along the block dim, the
+            # one shape op Mosaic lowers happily (column layout and the
+            # in-block row order stay untouched; lane-dim interleaves and
+            # row splits both failed to lower). Arithmetic shifts
+            # sign-extend int8: (q << 4) >> 4 is the signed low value,
+            # q >> 4 the signed high.
             low = jnp.right_shift(jnp.left_shift(q, 4), 4)
             high = jnp.right_shift(q, 4)
-            q = jnp.stack([low, high], axis=-1).reshape(*q.shape[:-1], -1)
-        qt = QuantizedTensor(q, self.scale, self.shape, self.bits)
+            q = jnp.concatenate([low, high], axis=-3)
+        # derive the dense shape from qdata's CURRENT dims, not the stored
+        # aux: lax.scan over a stacked [L, G, B, n] leaf hands the body a
+        # [G, B, n] slice still carrying the full-shape aux
+        shape = (*q.shape[:-3], q.shape[-3] * q.shape[-2], q.shape[-1])
+        qt = QuantizedTensor(q, self.scale, shape, self.bits)
         return dequantize_blockwise(qt, self.dtype)
 
 
@@ -114,14 +126,18 @@ def pack_quantize_blockwise(w: jax.Array, block: int = 128,
                             bits: int = 8) -> PackedWeight:
     """Quantize ``w`` into pytree-safe packed storage (see PackedWeight).
 
-    int4 with an even column count nibble-packs two values per byte — the
-    true quarter-width HBM stream; odd columns fall back to one int4 per
-    int8 byte (still half-width)."""
+    int4 with an even block count nibble-packs blocks g and g + G/2 into
+    one byte plane (qdata [..., G/2, B, n]) — the true quarter-width HBM
+    stream with the column layout untouched. The split-half block pairing
+    makes the unpack a block-dim concat, which Mosaic lowers (lane-dim
+    interleaves and in-block row splits do not). A single-block weight
+    falls back to one int4 per byte (still half-width)."""
     qt = quantize_blockwise(w, block, bits)
     q, nibbles = qt.qdata, False
-    if bits == 4 and q.shape[-1] % 2 == 0:
-        pairs = q.reshape(*q.shape[:-1], q.shape[-1] // 2, 2)
-        low, high = pairs[..., 0], pairs[..., 1]
+    if bits == 4 and q.shape[-3] % 2 == 0:
+        half = q.shape[-3] // 2
+        low = q[..., :half, :, :]
+        high = q[..., half:, :, :]
         q = jnp.bitwise_or(
             jnp.bitwise_and(low, jnp.int8(0x0F)), jnp.left_shift(high, 4)
         ).astype(jnp.int8)
@@ -145,27 +161,33 @@ def packed_sharding_ok(shape, spec, mesh, block: int = 128,
     """Whether packed storage of a weight with this PartitionSpec shards on
     ``mesh`` without splitting quantization blocks or nibble pairs.
 
-    The contraction dim d is stored as (G, B) with only G shardable, so the
-    spec's dim -2 extent must divide G; int4 nibble packing halves the
-    column count, so dim -1's extent must divide n//2."""
+    The contraction dim d is stored as (G, B) with only G shardable, so
+    the spec's dim -2 extent must divide G; columns shard exactly like
+    the dense weight. int4's split-half block pairing (byte plane g =
+    blocks g and g + G/2) is incompatible with sharding the block dim —
+    a contiguous byte-plane shard maps to two non-adjacent dense block
+    ranges — so row-parallel int4 weights fall back to fake-quant."""
     if spec is None:
         return True
     d, n = shape[-2], shape[-1]
     eff_block = block if d % block == 0 else d
     groups = d // eff_block
     s = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
-    ncols = n // 2 if (bits == 4 and n % 2 == 0) else n
-    return (groups % _axis_size(mesh, s[-2]) == 0
-            and ncols % _axis_size(mesh, s[-1]) == 0)
+    row_extent = _axis_size(mesh, s[-2])
+    if bits == 4 and groups % 2 == 0 and row_extent > 1:
+        return False
+    return (groups % row_extent == 0
+            and n % _axis_size(mesh, s[-1]) == 0)
 
 
 def packed_partition_specs(spec, ndim: int):
     """Expand an original weight's PartitionSpec onto PackedWeight storage.
 
-    qdata is [..., G, B, n] (nibble-packed: n//2) and scale [..., G, 1, n]:
-    both keep the leading axes, shard G with whatever sharded d, leave the
-    in-block axis replicated, and shard columns like the original — so TP
-    serving streams int8/int4 bytes per shard instead of bf16
+    qdata is [..., G, B, n] (int4 split-half packing: [..., G//2, B, n])
+    and scale [..., G, 1, n]: both keep the leading axes, shard the block
+    dim with whatever sharded d, leave the in-block axis replicated, and
+    shard columns like the original — so TP serving holds int8/int4 bytes
+    per shard instead of bf16
     (reference: DeepSpeed-Inference TP + weight-only quantization compose,
     deepspeed/module_inject + deepspeed/inference quantization)."""
     from jax.sharding import PartitionSpec as P
@@ -175,12 +197,28 @@ def packed_partition_specs(spec, ndim: int):
     return q, q
 
 
+def cast_floating(tree, dtype):
+    """astype(dtype) for floating leaves; PackedWeight nodes pass through
+    INTACT — their scales must stay fp32 (quantization quality) and their
+    qdata int8 (the HBM stream); the serve dtype is baked into the node's
+    aux at pack time."""
+    def c(a):
+        if isinstance(a, PackedWeight):
+            return a
+        return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+    return jax.tree_util.tree_map(
+        c, tree, is_leaf=lambda a: isinstance(a, PackedWeight)
+    )
+
+
 def materialize_packed(tree, dtype=None):
     """Dequantize every PackedWeight leaf; plain arrays pass through.
 
-    Call this INSIDE the jitted fn that consumes the params (for serving
-    loops: inside the loop BODY, so the dequant is not hoisted out and the
-    weights stream quantized from HBM)."""
+    Utility for exporting/inspecting packed params as dense weights. The
+    serving path does NOT use it — projections consume PackedWeight
+    directly via ops.pallas.quantized_matmul.packed_proj (dequantizing a
+    whole tree per decode step measured 3x slower than streaming)."""
     def dq(leaf):
         if isinstance(leaf, PackedWeight):
             w = leaf.dequantize()

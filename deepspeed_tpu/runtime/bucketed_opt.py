@@ -1,0 +1,106 @@
+"""Per-layer ("sub-group") optimizer stepping for offloaded state.
+
+Parity: deepspeed/runtime/zero/stage3.py partitions parameters into
+sub-groups (``sub_group_size``) and updates one group at a time precisely so
+CPU-offloaded optimizer state (ops/adam/cpu_adam in the reference) streams
+through a bounded device working set. The TPU-native form: the stacked
+decoder-layer leaves [L, ...] step inside one ``lax.scan`` over L — XLA
+schedules one layer's host→device m/v DMA, f32 update math, and
+device→host writeback per tick, so peak HBM temp is ONE layer's update
+working set instead of the whole tree's.
+
+Why it's needed: a fused whole-tree ``optax`` update materializes a f32
+temp per big leaf and the latency-hiding scheduler overlaps many of their
+host transfers — the 1.4B bench config compiled to 13.9G of HLO temps and
+OOM'd a 15.75G v5e. Scanned per-layer, the same math runs in a bounded
+slice of that.
+
+The state is ``{"rest": tx.init(non-layer leaves),
+"layers": vmap(tx.init)(per-layer slices)}`` — same optax inner structure,
+stacked along dim 0 for the layer part (count becomes [L], one per layer,
+all equal). Checkpoints save/load it like any pytree; note the structure
+differs from the unbucketed state, so toggling offload between save and
+load is a config change (documented in runtime/checkpointing.py terms: the
+tree must match).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import optax
+from jax import lax
+
+
+class BucketedOptimizer:
+    """Wraps a GradientTransformation with per-layer scanned stepping."""
+
+    def __init__(self, tx: optax.GradientTransformation,
+                 stacked_key: str = "layers"):
+        self.tx = tx
+        self.key = stacked_key
+
+    def split(self, tree: Dict[str, Any]):
+        rest = {k: v for k, v in tree.items() if k != self.key}
+        return rest, tree[self.key]
+
+    def init(self, params):
+        rest, layers = self.split(params)
+        return {
+            "rest": self.tx.init(rest),
+            # vmapped init: per-layer state slices stacked on dim 0
+            "layers": jax.vmap(self.tx.init)(layers),
+        }
+
+    def step(
+        self,
+        grads,
+        state,
+        params,
+        state_put: Optional[Tuple[Callable, Callable]] = None,
+        param_put: Optional[Tuple[Callable, Callable]] = None,
+    ) -> Tuple[Any, Any]:
+        """One optimizer step. Returns (new_params, new_state).
+
+        state_put/param_put: optional (to_device, to_host) per-layer-slice
+        placement hooks for offloaded trees (device_put into compute
+        memory on the way in, back to pinned host on the way out). They
+        pin the streaming behavior explicitly so the scheduler cannot
+        hoist a whole-tree transfer out of the scan; None when that tree
+        is device-resident (or on CPU meshes, which have no memory kinds).
+        """
+        g_rest, g_layers = self.split(grads)
+        p_rest, p_layers = self.split(params)
+        u_rest, s_rest = self.tx.update(g_rest, state["rest"], p_rest)
+        new_p_rest = optax.apply_updates(p_rest, u_rest)
+
+        def body(_, xs):
+            g_l, s_l, p_l = xs
+            if state_put is not None:
+                s_l = state_put[0](s_l)
+            if param_put is not None:
+                p_l = param_put[0](p_l)
+            u_l, s_new = self.tx.update(g_l, s_l, p_l)
+            p_new = optax.apply_updates(p_l, u_l)
+            if state_put is not None:
+                s_new = state_put[1](s_new)
+            if param_put is not None:
+                p_new = param_put[1](p_new)
+            return None, (p_new, s_new)
+
+        _, (new_p_layers, new_s_layers) = lax.scan(
+            body, None, (g_layers, state["layers"], p_layers)
+        )
+        new_params = dict(new_p_rest)
+        new_params[self.key] = new_p_layers
+        return new_params, {"rest": s_rest, "layers": new_s_layers}
+
+
+def bucketed_applicable(params_shape, stacked_key: str = "layers") -> bool:
+    """The scan needs the conventional stacked-layers param layout."""
+    return (
+        isinstance(params_shape, dict)
+        and stacked_key in params_shape
+        and len(params_shape) > 1
+    )

@@ -455,6 +455,32 @@ class TpuEngine:
         self._param_memory_kind = (
             "pinned_host" if (off_par.enabled and on_tpu) else None
         )
+        # CPU-offloaded optimizer state steps per-layer (sub_group_size
+        # semantics — see runtime/bucketed_opt.py): one layer's m/v/master
+        # streams through HBM per scan tick instead of the whole tree's
+        # f32 update temps at once (the 1.4B config OOM'd otherwise)
+        from .bucketed_opt import BucketedOptimizer, bucketed_applicable
+
+        self._bucketed_opt = (
+            BucketedOptimizer(self.optimizer_tx)
+            if (
+                off_opt.device == "cpu"
+                and not self._stacked_grads_axes
+                # fp16's overflow skip selects over the WHOLE old/new
+                # state, which would force full-width compute on the
+                # pinned-host layer leaves the scan keeps resident there;
+                # bf16/fp32 (the TPU-native paths) never take that select
+                and not self.fp16_enabled
+                and bucketed_applicable(params_shape)
+            )
+            else None
+        )
+        if off_opt.device == "cpu" and self.fp16_enabled:
+            log_dist(
+                "offload_optimizer + fp16: per-layer bucketed stepping is "
+                "disabled (the overflow-skip select needs the full state "
+                "on device); prefer bf16 on TPU for large offloaded models"
+            )
         if off_par.enabled and not on_tpu:
             log_dist(
                 "offload_param: pinned_host memory kinds need the TPU "
@@ -515,6 +541,25 @@ class TpuEngine:
                     self._stacked_grads_axes,
                     self._opt_memory_kind,
                 )
+            elif self._bucketed_opt is not None:
+                bshape = jax.eval_shape(self._bucketed_opt.init, params_shape)
+                rest_specs = {
+                    k: v for k, v in self.opt_leaf_specs.items()
+                    if k != self._bucketed_opt.key
+                }
+                opt_out_shardings = {
+                    "rest": opt_state_sharding(
+                        self.optimizer_tx, bshape["rest"], rest_specs,
+                        topology, self._opt_memory_kind,
+                    ),
+                    # vmapped per-layer state: param-shaped leaves are
+                    # stacked like the params, so the stacked specs apply
+                    "layers": opt_state_sharding(
+                        self.optimizer_tx, bshape["layers"],
+                        self.opt_leaf_specs[self._bucketed_opt.key],
+                        topology, self._opt_memory_kind,
+                    ),
+                }
             else:
                 opt_out_shardings = opt_state_sharding(
                     self.optimizer_tx,
@@ -523,9 +568,14 @@ class TpuEngine:
                     topology,
                     self._opt_memory_kind,
                 )
-            opt_state = jax.jit(
-                self.optimizer_tx.init, out_shardings=opt_out_shardings
-            )(params)
+            init_fn = (
+                self._bucketed_opt.init
+                if self._bucketed_opt is not None
+                else self.optimizer_tx.init
+            )
+            opt_state = jax.jit(init_fn, out_shardings=opt_out_shardings)(
+                params
+            )
         self.opt_shardings = jax.tree.map(lambda x: x.sharding, opt_state)
         self._opt_dev_shardings = (
             jax.tree.map(
@@ -575,6 +625,40 @@ class TpuEngine:
                 jax.device_put, params, self._param_dev_shardings
             )
         return params
+
+    def _bucketed_slice_put(self, shardings_tree):
+        """(to_device, to_host) placement hooks for one layer-slice of an
+        offloaded stacked tree (see BucketedOptimizer.step). The slice
+        shardings are the stacked leaves' with the leading (layer) spec
+        entry dropped; None on meshes without memory kinds (CPU tests run
+        the same scan, just without the DMA pinning)."""
+        kind = self._opt_memory_kind or self._param_memory_kind
+        if kind is None:
+            return None
+        mesh = self.topology.mesh
+        stacked = shardings_tree[self._bucketed_opt.key]
+
+        def drop_lead(ns, memory_kind=None):
+            spec = tuple(ns.spec)
+            spec = spec[1:] if spec else ()
+            kwargs = {"memory_kind": memory_kind} if memory_kind else {}
+            return NamedSharding(mesh, P(*spec), **kwargs)
+
+        dev = jax.tree.map(drop_lead, stacked)
+        # writeback respects each leaf's OWN final placement: the big
+        # param-shaped leaves (m/v/masters) return to pinned host, but
+        # small non-param leaves (e.g. adam's count) stay on device — a
+        # host-space s32 lane-update is also unsupported by the compiler
+        hst = jax.tree.map(
+            lambda ns: drop_lead(
+                ns, kind if getattr(ns, "memory_kind", None) == kind else None
+            ),
+            stacked,
+        )
+        return (
+            lambda t: jax.device_put(t, dev),
+            lambda t: jax.device_put(t, hst),
+        )
 
     def _effective_params(self, params):
         """Differentiable staging — must run *inside* the differentiated
@@ -814,11 +898,38 @@ class TpuEngine:
         # offloaded state: explicit copies host→device for compute; the step's
         # out_shardings put the new state back in pinned host memory, so XLA
         # schedules the DMA both ways around the math
-        params = self._device_params(params)
+        if self._bucketed_opt is not None and self._param_memory_kind:
+            # host-resident LAYER masters stream per layer inside the
+            # bucketed scan (a whole-tree copy here would defeat it); the
+            # non-layer leaves update as one group and need device copies
+            key = self._bucketed_opt.key
+            params = {
+                **jax.tree.map(
+                    jax.device_put,
+                    {k: v for k, v in params.items() if k != key},
+                    {k: v for k, v in self._param_dev_shardings.items()
+                     if k != key},
+                ),
+                key: params[key],
+            }
+        else:
+            params = self._device_params(params)
         if self._opt_memory_kind:
-            opt_state = jax.tree.map(
-                jax.device_put, opt_state, self._opt_dev_shardings
-            )
+            if self._bucketed_opt is not None:
+                opt_state = {
+                    "rest": jax.tree.map(
+                        jax.device_put,
+                        opt_state["rest"],
+                        self._opt_dev_shardings["rest"],
+                    ),
+                    # layer state stays pinned_host; the scan's state_put
+                    # hooks move one layer per tick
+                    "layers": opt_state["layers"],
+                }
+            else:
+                opt_state = jax.tree.map(
+                    jax.device_put, opt_state, self._opt_dev_shardings
+                )
         overflow = (
             ~grads_finite(grads) if self.fp16_enabled else jnp.asarray(False)
         )
@@ -837,8 +948,23 @@ class TpuEngine:
                 )
                 grads = jax.tree.map(lambda g: g * factor, grads)
 
-        updates, new_opt = self.optimizer_tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        if self._bucketed_opt is not None:
+            new_params, new_opt = self._bucketed_opt.step(
+                grads,
+                opt_state,
+                params,
+                state_put=self._bucketed_slice_put(self.opt_shardings),
+                param_put=(
+                    self._bucketed_slice_put(self.param_shardings)
+                    if self._param_memory_kind
+                    else None
+                ),
+            )
+        else:
+            updates, new_opt = self.optimizer_tx.update(
+                grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
 
         if self.fp16_enabled:
             # overflow → keep old state (skip step); bf16/fp32 never overflow
@@ -854,6 +980,27 @@ class TpuEngine:
             from ..compression.compress import redundancy_clean
 
             new_params = redundancy_clean(new_params, self.compression_masks)
+        if self._bucketed_opt is not None:
+            # the step must be memory-space-closed (train_batch_chain scans
+            # it: carry in == carry out): the rest-group state/params were
+            # device_put up top, so return them to their resting placement
+            key = self._bucketed_opt.key
+            if self._opt_memory_kind:
+                new_opt = {
+                    "rest": jax.device_put(
+                        new_opt["rest"], self.opt_shardings["rest"]
+                    ),
+                    "layers": new_opt["layers"],
+                }
+            if self._param_memory_kind:
+                new_params = {
+                    **jax.device_put(
+                        {k: v for k, v in new_params.items() if k != key},
+                        {k: v for k, v in self.param_shardings.items()
+                         if k != key},
+                    ),
+                    key: new_params[key],
+                }
         new_scale = update_loss_scale(loss_scale, overflow, cfg.fp16, self.fp16_enabled)
         # skipped steps don't advance the schedule (reference scheduler parity)
         new_step = step + jnp.where(overflow, 0, 1).astype(step.dtype)

@@ -321,3 +321,44 @@ def test_train_batch_chain_falls_back_per_step(devices8):
     assert losses.shape == (2,)
     assert engine.last_chain_metrics is None  # fallback path
     assert engine.global_steps == 2
+
+
+def test_bucketed_offload_update_matches_plain(devices8):
+    """CPU-offloaded optimizer state steps per-layer inside a lax.scan
+    (runtime/bucketed_opt.py, VERDICT r4 #2's enabler): the scanned update
+    must be numerically identical to the whole-tree optax update, and the
+    bucketed state must checkpoint/resume."""
+    base = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+    }
+    plain_losses, plain = _run_steps(
+        {**base, "zero_optimization": {"stage": 3}}, steps=4, vary_data=True
+    )
+    off = {
+        **base,
+        "zero_optimization": {"stage": 3,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    buck_losses, buck = _run_steps(off, steps=4, vary_data=True)
+    assert buck._bucketed_opt is not None
+    assert plain._bucketed_opt is None
+    np.testing.assert_allclose(plain_losses, buck_losses, rtol=1e-6)
+    # params: atol covers degenerate near-zero leaves (k-bias) where
+    # sqrt(v) ~ adam eps makes the update chaotic in summation order —
+    # verified leaf-by-leaf: all diffs are O(1e-7) except such leaves
+    for a, b in zip(jax.tree_util.tree_leaves(plain.state.params),
+                    jax.tree_util.tree_leaves(buck.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+    # the bucketed {"rest", "layers"} state round-trips a checkpoint
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        buck.save_checkpoint(d)
+        l_next = float(buck.train_batch(batch=_data(8, seed=99)))
+        buck.load_checkpoint(d)
+        l_again = float(buck.train_batch(batch=_data(8, seed=99)))
+    np.testing.assert_allclose(l_next, l_again, rtol=1e-6)

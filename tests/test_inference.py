@@ -721,9 +721,10 @@ def test_tp_packed_fallback_when_geometry_does_not_divide():
 
 @pytest.mark.parametrize("cols", [16, 15])
 def test_int4_nibble_packing_roundtrip(cols):
-    """int4 packed storage nibble-packs two values per byte (even columns:
-    half the int8 bytes) and dequantizes bit-identically to the unpacked
-    quantizer; odd columns fall back to one value per byte."""
+    """int4 packed storage nibble-packs blocks g and g+G/2 per byte plane
+    (half the int8 bytes, column and in-block row layout untouched — the
+    split-half pairing keeps the Pallas unpack a block-dim concat) and
+    dequantizes bit-identically to the unpacked quantizer."""
     from deepspeed_tpu.ops.quantizer import (
         dequantize_blockwise, pack_quantize_blockwise, quantize_blockwise,
     )
@@ -734,7 +735,21 @@ def test_int4_nibble_packing_roundtrip(cols):
                                jnp.float32)
     np.testing.assert_array_equal(np.asarray(pw.dequantize()),
                                   np.asarray(ref))
-    if cols % 2 == 0:
-        assert pw.nibbles and pw.qdata.shape[-1] == cols // 2
-    else:
-        assert not pw.nibbles and pw.qdata.shape[-1] == cols
+    # 2 blocks of 16 rows → one byte plane [1, 16, cols]
+    assert pw.nibbles
+    assert pw.qdata.shape[-3:] == (1, 16, cols)
+
+
+def test_int4_odd_block_falls_back_to_bytewise():
+    """An odd block COUNT can't pair split-halves: one int4 per byte."""
+    from deepspeed_tpu.ops.quantizer import (
+        dequantize_blockwise, pack_quantize_blockwise, quantize_blockwise,
+    )
+
+    w = jnp.asarray(np.random.RandomState(3).randn(15, 8), jnp.float32)
+    pw = pack_quantize_blockwise(w, block=16, bits=4)  # 15 % 16 → block 15
+    assert not pw.nibbles and pw.qdata.shape[-2] == 15
+    ref = dequantize_blockwise(quantize_blockwise(w, block=16, bits=4),
+                               jnp.float32)
+    np.testing.assert_array_equal(np.asarray(pw.dequantize()),
+                                  np.asarray(ref))
