@@ -196,13 +196,21 @@ def main():
         # mandatory and micro shrinks until weights+grads+activations fit.
         # The 410m sweep's winning flash tiles transfer (same S, hd).
         tiles = {"flash_block_q": 512, "flash_block_k": 1024}
+        # BENCH_MICRO pins the micro-batch for a single-rung probe
+        # (diagnosing which big-model rung a remote-compile crash is in)
+        mb_pin = int(os.environ.get("BENCH_MICRO", 0))
+        # measured ladder order (perf/bench_1b*.json): dots_flash@mb1 =
+        # 4,609 tok/s > full@mb4 4,460 > full@mb8 4,319 > full@mb2 4,335.
+        # Larger micro does NOT amortize the offload tax — the optimizer
+        # update (and its ~24 GB host DMA) runs once per global step under
+        # accumulation regardless. dots_flash at mb>=2 crashes the remote
+        # compile helper at 1.5B shapes, so mb1 leads.
         ladder = (
-            [(policy, mb_half, tiles)]
+            [(policy, mb_pin or mb_half, tiles)]
             if policy
             else [
-                ("dots_flash", mb_half, tiles),
-                ("dots_flash", max(mb_full // 4, 1), tiles),
-                ("full", max(mb_full // 4, 1), tiles),
+                ("dots_flash", 1, tiles),
+                ("full", max(mb_full // 2, 1), tiles),
                 ("full", 1, kernels_on),
                 ("full", 1, conservative),
             ]

@@ -270,6 +270,12 @@ class InferenceEngine:
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
             if name not in big or leaf.ndim < 2:
                 return leaf
+            if leaf.ndim > 3:
+                # MoE expert banks [L, E, d, f]: moe_layer's batched expert
+                # einsums consume dense weights, so experts take the
+                # fake-quant roundtrip (same numerics, bf16 stream) until
+                # the dispatch path learns PackedWeight
+                return quantize_dequantize(leaf, block=128, bits=bits)
             if sharded and not packed_sharding_ok(
                 leaf.shape, spec, self.topology.mesh, block=128, bits=bits
             ):

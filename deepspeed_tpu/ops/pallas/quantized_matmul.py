@@ -38,6 +38,15 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, *, nibbles: bool):
     x = x_ref[...].astype(jnp.float32)  # [M, D]
     q = q_ref[...]  # int8 [G, B, bn] (int4: [G//2, B, bn] split-half)
     s = s_ref[...]  # [G, 1, bn] f32
+    # fp32 serving must match the >8-row dequantize-einsum path (~1e-6):
+    # the default dot precision truncates f32 inputs to bf16 multiplies
+    # (~1e-2 relative — measured), which would make prefill and decode
+    # disagree numerically. bf16 serving keeps the fast default.
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if o_ref.dtype == jnp.float32
+        else None
+    )
     # the fold runs in f32 on purpose — measured on v5e at 410M: f32 fold
     # = 873 tok/s vs bf16 fold = 738 (16-bit register packing relayouts
     # cost more than the halved convert width) vs per-block post-dot
@@ -63,14 +72,14 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, *, nibbles: bool):
         qf = jnp.concatenate([low, high], axis=0)
         y = jax.lax.dot_general(
             x, qf, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=prec,
         )
     else:
         G, B, bn = q.shape
         qf = (q.astype(jnp.float32) * s).reshape(G * B, bn)
         y = jax.lax.dot_general(
             x, qf, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=prec,
         )
     o_ref[...] = y.astype(o_ref.dtype)
 

@@ -626,6 +626,20 @@ class TpuEngine:
             )
         return params
 
+    @staticmethod
+    def _put_except(tree, shardings, key):
+        """device_put every entry of ``tree`` except ``key`` (the bucketed
+        stacked-layers group, which streams per-slice in the update scan
+        and must keep its resting placement)."""
+        return {
+            **jax.tree.map(
+                jax.device_put,
+                {k: v for k, v in tree.items() if k != key},
+                {k: v for k, v in shardings.items() if k != key},
+            ),
+            key: tree[key],
+        }
+
     def _bucketed_slice_put(self, shardings_tree):
         """(to_device, to_host) placement hooks for one layer-slice of an
         offloaded stacked tree (see BucketedOptimizer.step). The slice
@@ -902,16 +916,9 @@ class TpuEngine:
             # host-resident LAYER masters stream per layer inside the
             # bucketed scan (a whole-tree copy here would defeat it); the
             # non-layer leaves update as one group and need device copies
-            key = self._bucketed_opt.key
-            params = {
-                **jax.tree.map(
-                    jax.device_put,
-                    {k: v for k, v in params.items() if k != key},
-                    {k: v for k, v in self._param_dev_shardings.items()
-                     if k != key},
-                ),
-                key: params[key],
-            }
+            params = self._put_except(
+                params, self._param_dev_shardings, self._bucketed_opt.key
+            )
         else:
             params = self._device_params(params)
         if self._opt_memory_kind:
@@ -984,23 +991,14 @@ class TpuEngine:
             # the step must be memory-space-closed (train_batch_chain scans
             # it: carry in == carry out): the rest-group state/params were
             # device_put up top, so return them to their resting placement
-            key = self._bucketed_opt.key
             if self._opt_memory_kind:
-                new_opt = {
-                    "rest": jax.device_put(
-                        new_opt["rest"], self.opt_shardings["rest"]
-                    ),
-                    "layers": new_opt["layers"],
-                }
+                new_opt = self._put_except(
+                    new_opt, self.opt_shardings, "layers"
+                )
             if self._param_memory_kind:
-                new_params = {
-                    **jax.device_put(
-                        {k: v for k, v in new_params.items() if k != key},
-                        {k: v for k, v in self.param_shardings.items()
-                         if k != key},
-                    ),
-                    key: new_params[key],
-                }
+                new_params = self._put_except(
+                    new_params, self.param_shardings, self._bucketed_opt.key
+                )
         new_scale = update_loss_scale(loss_scale, overflow, cfg.fp16, self.fp16_enabled)
         # skipped steps don't advance the schedule (reference scheduler parity)
         new_step = step + jnp.where(overflow, 0, 1).astype(step.dtype)

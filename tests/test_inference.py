@@ -753,3 +753,28 @@ def test_int4_odd_block_falls_back_to_bytewise():
                                jnp.float32)
     np.testing.assert_array_equal(np.asarray(pw.dequantize()),
                                   np.asarray(ref))
+
+
+def test_moe_quantized_serving_runs():
+    """MoE + weight quantization: expert banks [L, E, d, f] take the
+    fake-quant path (the batched expert einsums consume dense weights) and
+    the dense leaves still pack — serving runs end-to-end either way."""
+    from deepspeed_tpu.models import mixtral
+    from deepspeed_tpu.ops.quantizer import PackedWeight
+
+    model = mixtral("mixtral-tiny", vocab_size=128, max_seq_len=64,
+                    hidden_size=64, num_layers=2, num_heads=4,
+                    num_kv_heads=2, intermediate_size=128, num_experts=4,
+                    moe_top_k=2)
+    eng = init_inference(model, dtype=jnp.float32, quantize_bits=8,
+                         rng=jax.random.PRNGKey(9), max_tokens=24,
+                         topology=MeshTopology(devices=jax.devices()[:1]))
+    leaves = jax.tree_util.tree_leaves(
+        eng.params, is_leaf=lambda x: isinstance(x, PackedWeight))
+    packed = [l for l in leaves if isinstance(l, PackedWeight)]
+    assert packed  # attention projections still pack
+    assert all(len(pw.shape) <= 3 for pw in packed)  # experts excluded
+    prompt = np.random.RandomState(9).randint(0, 128, size=(1, 6))
+    out = eng.generate(prompt, max_new_tokens=6, temperature=0.0)
+    assert out.shape == (1, 12)
+    assert (np.asarray(out) < 128).all()
