@@ -120,6 +120,12 @@ def _pick_block_n(N: int, D: int) -> int:
 # once into a regular MXU matmul instead
 _MATVEC_MAX_ROWS = 8
 
+# Measured negative (r5): fusing qkv (and wi+wg) into ONE kernel call by
+# concatenating qdata/scale along columns in-trace LOST on-chip — int8
+# decode fell to 0.93x bf16 in-window vs 1.13x unfused (int4 1.12x vs
+# 1.27x). The int8 concat is evidently not hoisted out of the decode
+# while-loop (or the wider single grid schedules worse), so per-weight
+# launches stay.
 
 def packed_proj(x: jax.Array, w) -> jax.Array:
     """x[..., d] @ w[d, n] where w may be a PackedWeight.
