@@ -428,6 +428,11 @@ def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
         parts = (jax.tree.map(sl, layers), keys[lo:hi])
         return parts + ((pld_keep[lo:hi],) if use_pld else ())
 
+    # NOTE: unrolling this scan (lax.scan(..., unroll=2)) was measured
+    # 15% SLOWER on-chip at the record config (32,020 vs 37,682 tok/s) —
+    # the duplicated remat/checkpoint bodies cost more than the saved
+    # per-layer slice plumbing (the 16.9% DUS share in
+    # docs/xprof_r5_winner.md is grad STACKING, not loop overhead).
     carry = (x, jnp.zeros((), jnp.float32))
     if use_ltd:
         lo, hi = int(ltd_layers[0]), int(ltd_layers[1])
