@@ -124,6 +124,47 @@ def bench_model_and_data(smoke: bool):
     return model, data, B, S
 
 
+def time_chained_steps(engine, data, chain: int = 5, trials: int = 3) -> float:
+    """Median per-step seconds over chained-dispatch trials (one compile,
+    one readback per trial — the steady-state shape the records compare)."""
+    import time as _time
+
+    staged = engine.prepare_batch(data)
+    engine.train_batch_chain(batch=staged, steps=chain)  # compile the chain
+    float(engine.state.step)  # settle before the timed region
+    samples = []
+    for _ in range(trials):
+        t0 = _time.perf_counter()
+        engine.train_batch_chain(batch=staged, steps=chain)
+        # force a host read of the new state so the steps are actually done
+        # (block_until_ready alone has proven unreliable on relayed backends)
+        float(engine.state.step)
+        samples.append((_time.perf_counter() - t0) / chain)
+    return float(np.median(samples))  # median: the shared TPU pool is noisy
+
+
+def offload_report(engine, step_s: float):
+    """Offload-stream accounting for the bucketed ZeRO-offload leg: bytes
+    streamed per step, in-flight buffer bytes, and the DMA wall estimate at
+    the host-link bandwidth (BENCH_HOST_BW_GBS, GB/s) — the denominator of
+    the overlap ratio the A/B computes. None when nothing streams."""
+    off = getattr(engine, "offload_stream", None)
+    if not off:
+        return None
+    bw = float(os.environ.get("BENCH_HOST_BW_GBS", 32)) * 1e9  # bytes/s
+    total = off["bytes_in"] + off["bytes_out"]
+    dma_s = total / bw
+    return {
+        "gib_per_step": round(total / 2**30, 2),
+        "in_flight_mib": round(off["slots"] * off["slot_bytes"] / 2**20, 1),
+        "double_buffer": bool(off["double_buffer"]),
+        "est_dma_s": round(dma_s, 4),
+        # DMA wall as a fraction of the measured step — serial measured
+        # ~43% at 1.5B (docs/xprof_r5_1b_offload.md)
+        "est_dma_frac_of_step": round(min(dma_s / max(step_s, 1e-9), 1.0), 4),
+    }
+
+
 def load_sweep_seed(dp: int, B: int):
     """The committed sweep winner (SWEEP_BEST.json, written by
     tools/sweep_train.py) becomes the ladder's first rung — on the 16GB
@@ -184,9 +225,13 @@ def main():
         # fp32 master params AND adam m/v live in pinned host memory; the
         # bucketed per-layer update scan (runtime/bucketed_opt.py) streams
         # one layer of each through HBM per tick — the whole-tree update
-        # OOM'd at 19.6G/15.7G
+        # OOM'd at 19.6G/15.7G. BENCH_OFFLOAD_DB=1 turns on the
+        # double-buffered layer stream (offload_double_buffer knob);
+        # BENCH_OFFLOAD_AB=1 additionally times the other setting and
+        # reports the DMA-vs-compute overlap ratio.
         {"stage": 3, "offload_optimizer": {"device": "cpu"},
-         "offload_param": {"device": "cpu"}}
+         "offload_param": {"device": "cpu"},
+         "offload_double_buffer": bool(os.environ.get("BENCH_OFFLOAD_DB"))}
         if big
         else {"stage": 0}
     )
@@ -235,23 +280,27 @@ def main():
         # update + clip ≈ 5% of step): same ladder, Pallas fused adam on
         ladder = [(pol, mb, {**tk, "fused_adam": True})
                   for pol, mb, tk in ladder]
+    def ds_config(zero, pol, micro, tk):
+        """ONE config builder for the ladder and the offload A/B rebuild —
+        two inline dicts would silently drift apart as keys are added."""
+        return {
+            "train_batch_size": B,
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": zero,
+            "gradient_clipping": 1.0,
+            "steps_per_print": 1000,
+            "activation_checkpointing": {"policy": pol},
+            "tpu_kernels": tk,
+        }
+
     engine = None
     last_err = None
     for pol, micro, tk in ladder:
         try:
             engine, *_ = deepspeed_tpu.initialize(
-                model=model,
-                config={
-                    "train_batch_size": B,
-                    "train_micro_batch_size_per_gpu": micro,
-                    "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
-                    "bf16": {"enabled": True},
-                    "zero_optimization": zero_section,
-                    "gradient_clipping": 1.0,
-                    "steps_per_print": 1000,
-                    "activation_checkpointing": {"policy": pol},
-                    "tpu_kernels": tk,
-                },
+                model=model, config=ds_config(zero_section, pol, micro, tk)
             )
             engine.train_batch(batch=data)  # compile
             policy = f"{pol}@mb{micro}" + (
@@ -281,19 +330,40 @@ def main():
     # from the measurement (and from a real steady-state training loop).
     # The batch is staged on device ONCE: per-step device_put is a blocking
     # relay RPC before each dispatch (a real input pipeline prefetches).
-    staged = engine.prepare_batch(data)
-    chain = 5
-    engine.train_batch_chain(batch=staged, steps=chain)  # compile the chain
-    float(engine.state.step)  # settle before the timed region
-    trials = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        engine.train_batch_chain(batch=staged, steps=chain)
-        # force a host read of the new state so the steps are actually done
-        # (block_until_ready alone has proven unreliable on relayed backends)
-        float(engine.state.step)
-        trials.append((time.perf_counter() - t0) / chain)
-    dt = float(np.median(trials))  # median: the shared TPU pool is noisy
+    dt = time_chained_steps(engine, data)
+    offload = offload_report(engine, dt)
+    if offload is not None and os.environ.get("BENCH_OFFLOAD_AB") and big:
+        # A/B the double-buffer knob in the same window: rebuild the
+        # engine (the 1.5B state doesn't fit twice) with the knob flipped
+        # and report how much of the offload DMA the pipelined scan hides
+        from deepspeed_tpu.profiling.comm_logger import CommsLogger
+
+        db_first = bool(zero_section.get("offload_double_buffer"))
+        engine.destroy()
+        other_zero = dict(zero_section,
+                          offload_double_buffer=not db_first)
+        try:
+            engine, *_ = deepspeed_tpu.initialize(
+                model=model, config=ds_config(other_zero, pol, micro, tk)
+            )
+            engine.train_batch(batch=data)  # compile
+            dt_other = time_chained_steps(engine, data)
+        except Exception as e:  # noqa: BLE001 — the flipped setting may
+            # OOM (double buffering costs an extra layer slice on an
+            # already-tight leg); the A side's valid measurement must
+            # still be banked
+            offload["ab_error"] = (str(e).splitlines() or [repr(e)])[0][:160]
+            print(f"bench: offload A/B flipped-knob rung failed: "
+                  f"{offload['ab_error']}", file=sys.stderr)
+        else:
+            dt_serial, dt_db = (dt_other, dt) if db_first else (dt, dt_other)
+            offload["step_s_serial"] = round(dt_serial, 4)
+            offload["step_s_double_buffer"] = round(dt_db, 4)
+            offload["overlap_ratio"] = round(
+                CommsLogger.offload_overlap_ratio(
+                    dt_serial, dt_db, offload["est_dma_s"]
+                ), 4,
+            )
 
     tokens_per_step = B * S
     tok_per_sec = tokens_per_step / dt
@@ -338,8 +408,12 @@ def main():
         "mfu": round(mfu, 4),
         "step_time_s": round(dt, 4),
         "params_m": round(n_params / 1e6, 1),
-        "remat_policy": policy,
+        "remat_policy": policy + (
+            "+dbuf" if offload and offload["double_buffer"] else ""
+        ),
     }
+    if offload is not None:
+        result["offload"] = offload
     if not smoke:
         note = bank_record(cls, result)
         if note:

@@ -84,25 +84,28 @@ class Autotuner:
             m *= 2
         return [(mb, pol) for mb in mbs for pol in REMAT_POLICIES]
 
-    def _measure(self, micro_batch: int, remat: str,
-                 blocks: Tuple[int, int] = (0, 0)) -> Optional[float]:
-        """One candidate: fresh engine → compile+warmup → chained-dispatch
-        timing → tokens/sec. This is THE compile+measure loop — the operator
-        sweep (tools/sweep_train.py) is a CLI over it, so the two tuners
-        cannot drift.
+    def _settled_zero(self, rung) -> Dict[str, Any]:
+        """The zero section phases 1+ measure once the ladder settles:
+        the winning rung plus the user's non-conflicting zero keys.
+        stage and the offload subsections come from the rung — they ARE
+        what phase 0 decided."""
+        user = dict(self.base_config.get("zero_optimization") or {})
+        for k in ("stage", "offload_optimizer", "offload_param"):
+            user.pop(k, None)
+        return {**user, **dict(rung)}
 
-        Timing: the chip may sit behind a network relay where every host
-        readback pays the tunnel RTT, so each trial dispatches a chain of
-        steps with ONE blocking read at the end, and trials are reduced by
-        median (shared pools are noisy)."""
-        import deepspeed_tpu
-
+    def _candidate_config(self, micro_batch: int, remat: str,
+                          blocks: Tuple[int, ...] = (0, 0)) -> Dict[str, Any]:
+        """The exact ds_config one candidate measures (split out so tests
+        can assert what a probe runs without spinning an engine)."""
         cfg = dict(self.base_config)
         cfg.pop("autotuning", None)
         if self._zero_patch is not None:
-            base_zero = dict(cfg.get("zero_optimization") or {})
-            base_zero.update(self._zero_patch)
-            cfg["zero_optimization"] = base_zero
+            # the ladder rung REPLACES the section wholesale: merging the
+            # base config's keys in (dict.update) leaked user settings
+            # like offload_optimizer into lower-stage probes — stage 0 +
+            # cpu offload is a config the ladder never intends to measure
+            cfg["zero_optimization"] = dict(self._zero_patch)
         if self.topology is not None:
             dp = self.topology.data_shard_size
         else:
@@ -137,6 +140,22 @@ class Autotuner:
             tk["flash_block_q_bwd"], tk["flash_block_k_bwd"] = blocks[2:]
             cfg["tpu_kernels"] = tk
         cfg.setdefault("steps_per_print", 10**9)
+        return cfg
+
+    def _measure(self, micro_batch: int, remat: str,
+                 blocks: Tuple[int, int] = (0, 0)) -> Optional[float]:
+        """One candidate: fresh engine → compile+warmup → chained-dispatch
+        timing → tokens/sec. This is THE compile+measure loop — the operator
+        sweep (tools/sweep_train.py) is a CLI over it, so the two tuners
+        cannot drift.
+
+        Timing: the chip may sit behind a network relay where every host
+        readback pays the tunnel RTT, so each trial dispatches a chain of
+        steps with ONE blocking read at the end, and trials are reduced by
+        median (shared pools are noisy)."""
+        import deepspeed_tpu
+
+        cfg = self._candidate_config(micro_batch, remat, blocks)
         engine = None
         try:
             engine, *_ = deepspeed_tpu.initialize(
@@ -223,12 +242,24 @@ class Autotuner:
             ladder = tuple(z for z in ladder if z["stage"] <= 1)
         self._probe_tput = None
         for z in ladder:
-            self._zero_patch = dict(z)
+            self._zero_patch = dict(z)  # probes measure the rung EXACTLY
             tput = self._measure(1, REMAT_POLICIES[-1])
             if tput is not None:
                 log_dist(f"autotune: zero ladder settled on {z}")
                 self._probe_tput = tput
-                return dict(z)
+                # later phases (micro/remat/tiles) measure the winning
+                # rung ENRICHED with the user's non-conflicting zero keys
+                # (bucket sizes etc.) — stage/offload stay the ladder's
+                # decision, but dropping e.g. reduce_bucket_size would
+                # rank candidates on a config the user won't run
+                settled = self._settled_zero(z)
+                if settled != dict(z):
+                    # the probe ran the BARE rung; its tput must not be
+                    # recorded against the enriched section — phase 1
+                    # re-measures the (mb=1, max-remat) point
+                    self._probe_tput = None
+                self._zero_patch = settled
+                return dict(settled)
             log_dist(f"autotune: zero={z} OOM at mb=1/full; escalating")
         self._zero_patch = None
         raise RuntimeError(

@@ -119,6 +119,12 @@ class ZeroConfig:
     reduce_bucket_size: int = 5 * 10**8
     allgather_bucket_size: int = 5 * 10**8
     sub_group_size: int = 10**9
+    # double-buffer the bucketed per-layer offload update: prefetch layer
+    # i+1's pinned-host optimizer state while layer i's math runs, write
+    # layer i-1's result back concurrently (runtime/bucketed_opt.py).
+    # Costs one extra layer slice of HBM; off until on-chip parity + A/B
+    # land. "sub_group_prefetch" is accepted as an alias.
+    offload_double_buffer: bool = False
     offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = field(default_factory=OffloadConfig)
     stage3_max_live_parameters: int = 10**9
@@ -436,6 +442,9 @@ class DeepSpeedConfig:
         self.fp16 = _parse_dc(FP16Config, d.get("fp16"))
         self.bf16 = _parse_dc(BF16Config, d.get("bf16"))
         zo = dict(d.get("zero_optimization") or {})
+        if "sub_group_prefetch" in zo:  # alias (sub_group_size kin)
+            zo.setdefault("offload_double_buffer", zo["sub_group_prefetch"])
+        zo["offload_double_buffer"] = bool(zo.get("offload_double_buffer", False))
         zo["offload_optimizer"] = _parse_dc(OffloadConfig, zo.get("offload_optimizer"))
         zo["offload_param"] = _parse_dc(OffloadConfig, zo.get("offload_param"))
         self.zero_config = _parse_dc(ZeroConfig, zo)

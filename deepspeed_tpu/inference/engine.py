@@ -259,7 +259,11 @@ class InferenceEngine:
         shards along
         the weight's own partition spec (packed_partition_specs: blocks
         stay whole — the contraction dim is stored (G, B) and only G
-        shards), so TP serving streams quantized bytes per shard too. A
+        shards), so per-shard HBM *residency* stays quantized — but
+        packed_proj falls back to dequantize-then-dot whenever
+        world_size > 1 (a bare pallas_call has no GSPMD partitioning
+        rule), so each TP decode step re-materializes full-width weights
+        until the kernel grows a shard_map wrapper. A
         leaf whose block/nibble geometry does not divide over the mesh
         falls back to the fake-quant roundtrip (numerics identical either
         way — same q/dq values), logged by name."""
@@ -421,8 +425,13 @@ class InferenceEngine:
                     )
                     cand = cand[:, :k]  # the k-th draft is never proposed
                 # --- verify the whole window in one main forward --------
-                # packed weights stream via the Pallas kernel (the k-row
-                # verify stays under packed_proj's matvec threshold)
+                # packed weights stream via the Pallas matvec kernel only
+                # while the verify window fits _MATVEC_MAX_ROWS (8): the
+                # banked k=9 sweep's 10-row verify takes the
+                # dequantize-then-MXU path instead — same numerics, but
+                # full-width HBM traffic for that forward. Raising the
+                # threshold to ~16 needs an on-chip win at that row count
+                # first (unmeasured).
                 vlog, main_cache = forward_with_cache(
                     cfg, params, cand,
                     main_cache, pos, dtype=self.dtype

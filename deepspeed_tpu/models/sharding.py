@@ -59,24 +59,32 @@ def constrain(x, *spec_entries):
     if topo is None or topo.world_size == 1:
         return x
     spec = _filter_spec(PartitionSpec(*spec_entries), topo)
-    am = jax.sharding.get_abstract_mesh()
+    from ..utils.jax_compat import bound_axis_names, get_abstract_mesh
+
+    am = get_abstract_mesh()
     if am is not None and not am.empty:
         manual = {
             name
             for name, t in zip(am.axis_names, am.axis_types)
             if t == jax.sharding.AxisType.Manual
         }
-        if manual:
-            def drop(entry):
-                if entry is None:
-                    return None
-                if isinstance(entry, (tuple, list)):
-                    kept = tuple(a for a in entry if a not in manual)
-                    return kept if kept else None
-                return None if entry in manual else entry
+    else:
+        # legacy jax (no abstract mesh): probe the bound-axis env (legacy
+        # shard_map is always fully manual — jax_compat.shard_map refuses
+        # partial-manual there — so every bound axis is Manual)
+        manual = bound_axis_names(topo.mesh.axis_names)
+    if manual:
+        def drop(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a not in manual)
+                return kept if kept else None
+            return None if entry in manual else entry
 
-            spec = PartitionSpec(*(drop(e) for e in spec))
-            return jax.lax.with_sharding_constraint(x, NamedSharding(am, spec))
+        spec = PartitionSpec(*(drop(e) for e in spec))
+        mesh = am if am is not None and not am.empty else topo.mesh
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
 
 

@@ -23,6 +23,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...utils.jax_compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
 LANES = 128
 NEG_INF = -1e30
 DEFAULT_BLOCK_S = 256
@@ -165,7 +169,7 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
             pltpu.VMEM((G, LANES), jnp.float32),
             pltpu.VMEM((G, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -221,8 +225,9 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
             k_scale=k_scale, v_scale=v_scale, interpret=interp,
         )
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ...utils.jax_compat import shard_map
 
     batch_axes = tuple(a for a in ("dp", "fsdp") if topo.sizes[a] > 1)
     b_ax = batch_axes if batch_axes else None

@@ -31,6 +31,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...utils.jax_compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
 # Measured on v5e (llama-410M, S=2048, bf16): 512x512 tiles beat 256x256
 # by 24% end-to-end train throughput (the 256 grid left the MXU ~10%
 # utilized in the flash kernels); 512x1024 adds ~3% more but only divides
@@ -386,7 +390,7 @@ def _flash_fwd(q, k, v, bias, seg, slopes, tables, offsets=None, *, causal,
         pltpu.VMEM((block_q, LANES), jnp.float32),
         pltpu.VMEM((block_q, D), jnp.float32),
     ]
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
     )
     if sparse:
@@ -674,7 +678,7 @@ def _bias_grad_call(q, k, v, bias, seg, slopes, do, lse, delta, *,
         # output carries the bias dtype directly (no fp32 shadow + cast pass)
         out_shape=jax.ShapeDtypeStruct((Bb, Hb, S, S), bias.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -686,7 +690,7 @@ def _bwd_call(kernel, grid, in_specs, out_specs, out_shape, scratch_shapes,
               operands, sparse_tables, interpret):
     """Dispatch one backward pallas_call, with the scalar-prefetch grid
     spec when a compaction table drives the last grid dim."""
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
     )
     if sparse_tables is not None:
@@ -1108,6 +1112,16 @@ def flash_attention(
             f"local heads {local_H} not a multiple of local kv {local_KV} "
             f"under tp*sp={head_div}"
         )
+    if distributed and not hasattr(jax, "shard_map"):
+        from ...utils.jax_compat import bound_axis_names
+
+        if bound_axis_names(topo.mesh.axis_names):
+            # nesting a shard_map inside a manual context makes legacy
+            # 0.4.x's SPMD partitioner hard-abort (CHECK IsManualSubgroup);
+            # the XLA impl partitions fine there
+            reasons.append(
+                "legacy jax: nested shard_map inside a manual context"
+            )
     if reasons:
         _log_fallback_once(reasons)
         if block_mask is not None:
@@ -1197,8 +1211,9 @@ def flash_attention(
         )
 
     if distributed:
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from ...utils.jax_compat import shard_map
 
         batch_axes = tuple(a for a in ("dp", "fsdp") if topo.sizes[a] > 1)
         head_axes = tuple(
@@ -1209,18 +1224,22 @@ def flash_attention(
         # grads 1-bit path) some axes are already Manual: the nested
         # shard_map must use the context's abstract mesh and may only map
         # the still-Auto axes — arrays arrive already local on Manual ones
-        am = jax.sharding.get_abstract_mesh()
-        in_manual = (
-            am is not None
-            and not am.empty
-            and any(t == jax.sharding.AxisType.Manual for t in am.axis_types)
-        )
-        if in_manual:
+        from ...utils.jax_compat import bound_axis_names, get_abstract_mesh
+
+        am = get_abstract_mesh()
+        if am is not None and not am.empty:
             auto = {
                 name
                 for name, t in zip(am.axis_names, am.axis_types)
                 if t == jax.sharding.AxisType.Auto
             }
+            in_manual = len(auto) < len(am.axis_names)
+        else:
+            # legacy jax (no abstract mesh): probe the bound-axis env
+            manual = bound_axis_names(topo.mesh.axis_names)
+            in_manual = bool(manual)
+            auto = set(topo.mesh.axis_names) - manual
+        if in_manual:
             batch_axes = tuple(a for a in batch_axes if a in auto)
             head_axes = tuple(a for a in head_axes if a in auto)
         b_ax = batch_axes if batch_axes else None
@@ -1268,7 +1287,9 @@ def flash_attention(
             kw["axis_names"] = mapped
         out = shard_map(
             body,
-            mesh=am if in_manual else topo.mesh,
+            # legacy jax has no abstract mesh — the concrete mesh plus the
+            # axis_names→auto translation in jax_compat covers it
+            mesh=am if (in_manual and am is not None) else topo.mesh,
             in_specs=(
                 spec_q, spec_q, spec_q,
                 bias_spec,

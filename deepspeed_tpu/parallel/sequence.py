@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..utils.jax_compat import axis_size as _axis_size
 from jax.sharding import PartitionSpec as P
 
 from ..models.sharding import constrain, current_topology
@@ -49,7 +51,9 @@ def get_sp_mode() -> str:
 
 
 def _in_manual_context() -> bool:
-    am = jax.sharding.get_abstract_mesh()
+    from ..utils.jax_compat import get_abstract_mesh
+
+    am = get_abstract_mesh()
     return (
         am is not None
         and not am.empty
@@ -128,7 +132,7 @@ def _ring_attention_local(q, k, v, seg_q, seg_k, slopes, *, causal: bool,
     q/k/v: local blocks [B, S_loc, H|KV, hd]; positions are globalized from
     the ring index, so causal masking is exact across blocks.
     """
-    sp = lax.axis_size(axis)
+    sp = _axis_size(axis)
     i = lax.axis_index(axis)
     B, Sq, H, hd = q.shape
     KV = k.shape[2]
@@ -256,7 +260,9 @@ def ring_attention(q, k, v, *, causal=True, segment_ids=None,
             sl if has_alibi else None, causal=causal, axis=axis,
         )
 
-    run = jax.shard_map(
+    from ..utils.jax_compat import shard_map
+
+    run = shard_map(
         body,
         mesh=topo.mesh,
         in_specs=(

@@ -49,6 +49,14 @@ class CommsLogger:
         self.counts: Dict[str, int] = defaultdict(int)
         self.bytes: Dict[str, int] = defaultdict(int)
         self.per_axis: Dict[tuple, int] = defaultdict(int)
+        # offload-stream accounting (bucketed ZeRO-offload update): the
+        # host↔HBM optimizer-state DMA is not a collective, so the hook bus
+        # never sees it — the engine reports it explicitly per step
+        self.offload_steps = 0
+        self.offload_bytes_in = 0
+        self.offload_bytes_out = 0
+        self.offload_slots = 0
+        self.offload_slot_bytes = 0
         self._t0 = time.time()
         register_comm_hook(self._on_op)
 
@@ -72,6 +80,55 @@ class CommsLogger:
 
     def stop(self) -> None:
         unregister_comm_hook(self._on_op)
+
+    # ------------------------------------------------ offload stream stats
+    def record_offload(self, nbytes_in: int, nbytes_out: int,
+                       slots: int = 1, slot_bytes: int = 0,
+                       steps: int = 1) -> None:
+        """Account one (or ``steps`` chained) bucketed-offload optimizer
+        steps: ``nbytes_in``/``nbytes_out`` are the per-step host→HBM and
+        HBM→host stream totals, ``slots`` the rotating-buffer depth (2 when
+        double-buffered) and ``slot_bytes`` one layer slice — so
+        ``slots * slot_bytes`` is the peak bytes in flight."""
+        self.offload_steps += steps
+        self.offload_bytes_in += nbytes_in * steps
+        self.offload_bytes_out += nbytes_out * steps
+        self.offload_slots = max(self.offload_slots, slots)
+        self.offload_slot_bytes = max(self.offload_slot_bytes, slot_bytes)
+
+    @property
+    def offload_bytes_in_flight(self) -> int:
+        """Peak concurrent offload-stream bytes (slots × one layer slice)."""
+        return self.offload_slots * self.offload_slot_bytes
+
+    @staticmethod
+    def offload_overlap_ratio(serial_step_s: float, overlapped_step_s: float,
+                              dma_s: float) -> float:
+        """Fraction of the offload DMA wall time hidden under compute,
+        from an A/B of the serial vs double-buffered step: the DMA that
+        stopped being exposed, over the DMA there was to hide. 0 = fully
+        serialized (the xprof_r5_1b_offload baseline), 1 = fully
+        overlapped. ``dma_s`` is the estimated one-way+back DMA wall time
+        (stream bytes / host-link bandwidth)."""
+        if dma_s <= 0:
+            return 0.0
+        ratio = (serial_step_s - overlapped_step_s) / dma_s
+        return max(0.0, min(1.0, ratio))
+
+    def offload_summary(self, duration_s: Optional[float] = None) -> str:
+        """One line of offload-stream accounting (empty when none ran)."""
+        if not self.offload_steps:
+            return ""
+        dur = self.elapsed if duration_s is None else duration_s
+        total = self.offload_bytes_in + self.offload_bytes_out
+        gbps = total * 8 / dur / 1e9 if dur > 0 else 0.0
+        per_step = total / self.offload_steps
+        return (
+            f"offload stream: {self.offload_steps} steps, "
+            f"{per_step / 2**30:.2f} GiB/step (in+out), "
+            f"{self.offload_bytes_in_flight / 2**20:.1f} MiB in flight "
+            f"({self.offload_slots} slot(s)), {gbps:.2f} Gbps over window"
+        )
 
     @property
     def elapsed(self) -> float:
@@ -108,6 +165,9 @@ class CommsLogger:
             lines.append(
                 f"{op:<22}{c:>8}{b:>16}{b // max(c, 1):>14}{alg:>13.3f}{bus:>13.3f}"
             )
+        off = self.offload_summary(duration_s=dur)
+        if off:
+            lines.append(off)
         return "\n".join(lines)
 
     def log_summary(self, axis_sizes: Optional[Dict[str, int]] = None) -> None:

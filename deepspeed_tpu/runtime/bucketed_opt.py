@@ -22,6 +22,21 @@ all equal). Checkpoints save/load it like any pytree; note the structure
 differs from the unbucketed state, so toggling offload between save and
 load is a config change (documented in runtime/checkpointing.py terms: the
 tree must match).
+
+Double buffering (``double_buffer=True``, config knob
+``zero_optimization.offload_double_buffer`` a.k.a. ``sub_group_prefetch``):
+the serial scan's body makes layer *i*'s host→HBM state DMA a data
+dependency of layer *i*'s update math, so the scheduler cannot overlap
+them (measured: ~43% of the 1.5B offload step is unoverlapped DMA,
+docs/xprof_r5_1b_offload.md). The pipelined variant carries a two-slot
+rotating buffer through the scan instead: the slice consumed at tick *i*
+was prefetched at tick *i−1*, and tick *i* starts layer *i+1*'s prefetch
+BEFORE the update math — the prefetch has no dependency on the update, so
+XLA's latency-hiding scheduler is free to run the DMA under the compute
+(the same warm-up-then-prefetch-next structure a hand-written Pallas
+double-buffer loop uses). Costs one extra layer slice of HBM residency
+(two slots live instead of one). The math per layer and its order are
+identical, so trajectories match the serial scan bitwise on any mesh.
 """
 
 from __future__ import annotations
@@ -29,6 +44,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax import lax
 
@@ -37,9 +53,10 @@ class BucketedOptimizer:
     """Wraps a GradientTransformation with per-layer scanned stepping."""
 
     def __init__(self, tx: optax.GradientTransformation,
-                 stacked_key: str = "layers"):
+                 stacked_key: str = "layers", double_buffer: bool = False):
         self.tx = tx
         self.key = stacked_key
+        self.double_buffer = double_buffer
 
     def split(self, tree: Dict[str, Any]):
         rest = {k: v for k, v in tree.items() if k != self.key}
@@ -76,13 +93,27 @@ class BucketedOptimizer:
         new_p_rest = optax.apply_updates(p_rest, u_rest)
         s_layers = state["layers"]
 
+        if self.double_buffer:
+            new_p_layers, new_s_layers = self._scan_double_buffered(
+                g_layers, s_layers, p_layers, state_put, param_put
+            )
+        else:
+            new_p_layers, new_s_layers = self._scan_serial(
+                g_layers, s_layers, p_layers, state_put, param_put
+            )
+        new_params = dict(new_p_rest)
+        new_params[self.key] = new_p_layers
+        return new_params, {"rest": s_rest, "layers": new_s_layers}
+
+    def _scan_serial(self, g_layers, s_layers, p_layers, state_put, param_put):
         # one lax.scan over the layer dim, placement hooks inside the body.
         # A hand-pipelined fori_loop variant (explicit one-slice prefetch +
         # per-slice dynamic_update writebacks) was built and MEASURED
         # SLOWER on-chip: 3,278 vs 4,609 tok/s at 1.5B — the manual
         # slicing/update structure cost more than the prefetch hid, so the
         # scan stays; overlapping the state DMA (29% of the step,
-        # docs/xprof_r5_1b_offload.md) needs a compiler-level lever.
+        # docs/xprof_r5_1b_offload.md) needs the double-buffer variant
+        # below.
         def body(_, xs):
             g_l, s_l, p_l = xs
             if state_put is not None:
@@ -97,12 +128,60 @@ class BucketedOptimizer:
                 p_new = param_put[1](p_new)
             return None, (p_new, s_new)
 
-        _, (new_p_layers, new_s_layers) = lax.scan(
+        _, (new_p, new_s) = lax.scan(
             body, None, (g_layers, s_layers, p_layers)
         )
-        new_params = dict(new_p_rest)
-        new_params[self.key] = new_p_layers
-        return new_params, {"rest": s_rest, "layers": new_s_layers}
+        return new_p, new_s
+
+    def _scan_double_buffered(self, g_layers, s_layers, p_layers,
+                              state_put, param_put):
+        """Software-pipelined layer stream with a two-slot rotating buffer.
+
+        The carry holds the CURRENT layer's device-resident s/p slices
+        (prefetched one tick earlier); each tick starts the NEXT layer's
+        prefetch first — it has no data dependency on the update math, so
+        the scheduler can overlap the host→HBM DMA with the compute —
+        then runs the update on the carried slot and streams the result
+        back through the writeback hooks. Layer order and per-layer math
+        are identical to the serial scan, so trajectories match exactly.
+
+        The stacked s/p trees stay scan-invariant closures (scan xs would
+        re-serialize the slice-in against the body) and the prefetch index
+        is clamped at the last tick rather than lax.cond-guarded: the
+        branch-free body keeps the copy-start hoistable, at the price of
+        one redundant layer re-fetch per step (~1/L of the stream).
+        Gradients are device-resident already and ride as plain scan xs.
+        """
+        s_in = state_put[0] if state_put is not None else (lambda t: t)
+        s_out = state_put[1] if state_put is not None else (lambda t: t)
+        p_in = param_put[0] if param_put is not None else (lambda t: t)
+        p_out = param_put[1] if param_put is not None else (lambda t: t)
+        L = jax.tree_util.tree_leaves(g_layers)[0].shape[0]
+
+        def slice_at(tree, i):
+            return jax.tree.map(
+                lambda x: lax.dynamic_index_in_dim(x, i, 0, keepdims=False),
+                tree,
+            )
+
+        # warm-up: prefetch layer 0 into the first slot before the scan
+        carry0 = (s_in(slice_at(s_layers, 0)), p_in(slice_at(p_layers, 0)))
+
+        def body(carry, xs):
+            g_l, i = xs
+            s_l, p_l = carry
+            # kick off layer i+1's slice-in first (independent of the math)
+            nxt = jnp.minimum(i + 1, L - 1)
+            s_next = s_in(slice_at(s_layers, nxt))
+            p_next = p_in(slice_at(p_layers, nxt))
+            u_l, s_new = self.tx.update(g_l, s_l, p_l)
+            p_new = optax.apply_updates(p_l, u_l)
+            return (s_next, p_next), (p_out(p_new), s_out(s_new))
+
+        _, (new_p, new_s) = lax.scan(
+            body, carry0, (g_layers, jnp.arange(L))
+        )
+        return new_p, new_s
 
 
 def bucketed_applicable(params_shape, stacked_key: str = "layers") -> bool:
@@ -112,3 +191,26 @@ def bucketed_applicable(params_shape, stacked_key: str = "layers") -> bool:
         and stacked_key in params_shape
         and len(params_shape) > 1
     )
+
+
+def stacked_dim0_unsharded(*specs_trees) -> bool:
+    """True iff no stacked leaf shards its leading (layer) dim.
+
+    The engine's per-slice placement hooks derive the slice sharding by
+    dropping spec entry 0 (``_bucketed_slice_put``'s ``drop_lead``); if
+    ``add_data_axes`` ever shards dim 0 (L can be the largest dp-divisible
+    dim, e.g. small hidden sizes), the writeback would restore a DIFFERENT
+    sharding than the resting one and break the carry-in == carry-out
+    closure ``train_batch_chain`` scans over. Callers gate bucketed
+    stepping on this predicate instead."""
+    from jax.sharding import PartitionSpec as P
+
+    for tree in specs_trees:
+        leaves = jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, P)
+        )
+        for spec in leaves:
+            entries = tuple(spec)
+            if entries and entries[0] is not None:
+                return False
+    return True

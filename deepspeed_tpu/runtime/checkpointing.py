@@ -60,10 +60,12 @@ def _to_host(leaf) -> np.ndarray:
     from jax.sharding import NamedSharding, PartitionSpec
 
     kind = getattr(leaf.sharding, "memory_kind", None)
-    if kind and kind != "device":
+    if kind and kind != "device" and hasattr(leaf.sharding, "mesh"):
         # offloaded (pinned_host) leaves can't be read directly through all
         # PJRT transports — bounce through device memory first (plain
-        # device_put: no compilation, unlike a per-leaf jitted identity)
+        # device_put: no compilation, unlike a per-leaf jitted identity).
+        # Mesh-less shardings (SingleDeviceSharding on CPU backends whose
+        # default kind is a host kind) are directly readable — skip.
         dev = NamedSharding(leaf.sharding.mesh, leaf.sharding.spec)
         leaf = jax.device_put(leaf, dev)
     if getattr(leaf, "is_fully_addressable", True):

@@ -355,10 +355,19 @@ class TpuEngine:
         data_axes_live = tuple(
             a for a in ("dp", "fsdp") if topology.sizes[a] > 1
         )
+        # the wire path shard_maps ONLY the data axes; on legacy jax 0.4.x
+        # a further live axis makes that partial-manual, which its SPMD
+        # partitioner cannot compile (jax_compat.shard_map refuses it) —
+        # degrade to the numerics-only variant instead of dying
+        wire_shardable = hasattr(jax, "shard_map") or all(
+            topology.sizes[a] <= 1 or a in data_axes_live
+            for a in topology.sizes
+        )
         if (
             opt_name in ("onebitadam", "onebitlamb")
             and optimizer is None
             and data_axes_live
+            and wire_shardable
             and config.zero_config.stage <= 1
             and config.pipeline.stages <= 1
             and not getattr(model, "is_pipeline_module", False)
@@ -387,6 +396,9 @@ class TpuEngine:
                 # the numerics-only variant compresses nothing on the wire
                 why = (
                     "no >1-size data axis" if not data_axes_live
+                    else "legacy jax cannot compile the partial-manual "
+                         "wire shard_map beside other live mesh axes"
+                    if not wire_shardable
                     else "ZeRO stage > 1" if config.zero_config.stage > 1
                     else "pipeline parallelism"
                 )
@@ -450,7 +462,11 @@ class TpuEngine:
             from .swap_tensor import TensorSwapper
 
             self._nvme_swapper = TensorSwapper(
-                os.path.join(off_opt.nvme_path, "zero_opt_swap")
+                os.path.join(off_opt.nvme_path, "zero_opt_swap"),
+                # host buffer reuse is only safe when device_put really
+                # copies (TPU HBM); the CPU client can zero-copy alias
+                reuse_buffers=on_tpu,
+                buffer_count=off_opt.buffer_count,
             )
         self._param_memory_kind = (
             "pinned_host" if (off_par.enabled and on_tpu) else None
@@ -459,20 +475,41 @@ class TpuEngine:
         # semantics — see runtime/bucketed_opt.py): one layer's m/v/master
         # streams through HBM per scan tick instead of the whole tree's
         # f32 update temps at once (the 1.4B config OOM'd otherwise)
-        from .bucketed_opt import BucketedOptimizer, bucketed_applicable
+        from .bucketed_opt import (
+            BucketedOptimizer,
+            bucketed_applicable,
+            stacked_dim0_unsharded,
+        )
 
-        self._bucketed_opt = (
-            BucketedOptimizer(self.optimizer_tx)
-            if (
-                off_opt.device == "cpu"
-                and not self._stacked_grads_axes
-                # fp16's overflow skip selects over the WHOLE old/new
-                # state, which would force full-width compute on the
-                # pinned-host layer leaves the scan keeps resident there;
-                # bf16/fp32 (the TPU-native paths) never take that select
-                and not self.fp16_enabled
-                and bucketed_applicable(params_shape)
+        bucketable = (
+            off_opt.device == "cpu"
+            and not self._stacked_grads_axes
+            # fp16's overflow skip selects over the WHOLE old/new
+            # state, which would force full-width compute on the
+            # pinned-host layer leaves the scan keeps resident there;
+            # bf16/fp32 (the TPU-native paths) never take that select
+            and not self.fp16_enabled
+            and bucketed_applicable(params_shape)
+        )
+        if bucketable and not stacked_dim0_unsharded(
+            self.param_specs["layers"], self.opt_leaf_specs["layers"]
+        ):
+            # the per-slice placement hooks drop spec entry 0; a dp-sharded
+            # layer dim would come back with a different sharding than its
+            # resting one and break the chain's carry-in == carry-out
+            bucketable = False
+            log_dist(
+                "offload_optimizer: per-layer bucketed stepping disabled — "
+                "a stacked leaf shards its leading (layer) dim, which the "
+                "slice placement hooks cannot round-trip; running the "
+                "whole-tree update"
             )
+        self._bucketed_opt = (
+            BucketedOptimizer(
+                self.optimizer_tx,
+                double_buffer=zc.offload_double_buffer,
+            )
+            if bucketable
             else None
         )
         if off_opt.device == "cpu" and self.fp16_enabled:
@@ -589,6 +626,7 @@ class TpuEngine:
         self.state = TrainState(
             params, opt_state, loss_scale, jnp.zeros((), jnp.int32)
         )
+        self.offload_stream = self._compute_offload_stream()
         if self._nvme_swapper is not None:
             # optimizer state lives on disk between steps (reference:
             # partitioned_optimizer_swapper); swapped in around each update
@@ -616,6 +654,60 @@ class TpuEngine:
                 offload_params=config.zero_config.offload_param.enabled,
             )
             see_memory_usage("after engine init")
+
+    # --------------------------------------------------- offload accounting
+    def _compute_offload_stream(self):
+        """Static per-step host↔HBM DMA byte counts for the bucketed
+        offload stream (None when no pinned-host leaves stream). Every
+        pinned-host stacked leaf is read in and written back once per
+        optimizer step, so the counts come straight from the resting
+        shardings; ``slot_bytes`` is one layer slice (the scan's in-flight
+        unit — double buffering keeps ``slots`` of them resident)."""
+        if self._bucketed_opt is None or self.state is None:
+            return None
+        kind = self._opt_memory_kind or self._param_memory_kind
+        if kind is None:
+            return None  # CPU mesh: no memory kinds, nothing streams
+        key = self._bucketed_opt.key
+
+        def host_bytes(tree):
+            n = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if getattr(leaf.sharding, "memory_kind", None) == kind:
+                    n += leaf.size * leaf.dtype.itemsize
+            return n
+
+        state_b = (
+            host_bytes(self.state.opt_state[key])
+            if self._opt_memory_kind
+            else 0
+        )
+        param_b = (
+            host_bytes(self.state.params[key])
+            if self._param_memory_kind
+            else 0
+        )
+        total = state_b + param_b
+        if total == 0:
+            return None
+        n_layers = jax.tree_util.tree_leaves(self.state.params[key])[0].shape[0]
+        slots = 2 if self._bucketed_opt.double_buffer else 1
+        return {
+            "bytes_in": total,
+            "bytes_out": total,
+            "slot_bytes": total // max(n_layers, 1),
+            "slots": slots,
+            "layers": int(n_layers),
+            "double_buffer": self._bucketed_opt.double_buffer,
+        }
+
+    def _record_offload_stream(self, steps: int = 1):
+        if self.comm_logger is not None and self.offload_stream:
+            s = self.offload_stream
+            self.comm_logger.record_offload(
+                s["bytes_in"], s["bytes_out"],
+                slots=s["slots"], slot_bytes=s["slot_bytes"], steps=steps,
+            )
 
     # ------------------------------------------------------------------ step
     def _device_params(self, params):
@@ -862,7 +954,9 @@ class TpuEngine:
             loss = jax.lax.pmean(loss, axes)
             return jax.tree.map(lambda g: g[None], grads), loss
 
-        run = jax.shard_map(
+        from ..utils.jax_compat import shard_map
+
+        run = shard_map(
             local_fn,
             mesh=topo.mesh,
             in_specs=(P(), P(None, ax_entry), P(), P(), P()),
@@ -1203,6 +1297,7 @@ class TpuEngine:
             self._swap_out_opt(blocking=False)  # writes overlap next step
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
+        self._record_offload_stream()
         self._metrics = {k: v for k, v in metrics.items()}
         # only the fp16 path reads overflow on host — a host read here forces
         # a device sync every step and kills async dispatch overlap
@@ -1397,6 +1492,7 @@ class TpuEngine:
         self.state = TrainState(p, o, s, st)
         self.global_steps += steps
         self.micro_steps += steps * self.config.gradient_accumulation_steps
+        self._record_offload_stream(steps=steps)
         self.last_chain_metrics = ms
         # expose the final step's metrics where train_batch puts them
         self._metrics = {k: v[-1] for k, v in ms.items()}

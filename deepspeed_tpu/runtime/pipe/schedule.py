@@ -158,7 +158,9 @@ def pipelined_stack(
         aux_total = lax.psum(jnp.sum(auxs), "pp")  # sum over stages+ticks
         return ys, aux_total / M
 
-    run = jax.shard_map(
+    from ...utils.jax_compat import shard_map
+
+    run = shard_map(
         body,
         mesh=topo.mesh,
         in_specs=(P("pp"), P(), P(), P()),

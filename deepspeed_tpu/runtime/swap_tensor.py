@@ -21,7 +21,8 @@ from ..ops.aio import AsyncIOHandle
 
 
 class TensorSwapper:
-    def __init__(self, swap_dir: str, num_threads: int = 4):
+    def __init__(self, swap_dir: str, num_threads: int = 4,
+                 reuse_buffers: bool = False, buffer_count: int = 4):
         self.swap_dir = swap_dir
         os.makedirs(swap_dir, exist_ok=True)
         self.aio = AsyncIOHandle(num_threads=num_threads)
@@ -29,6 +30,35 @@ class TensorSwapper:
         # in-flight write requests per name, plus the host buffers they read
         # from (kept alive until the write completes)
         self._pending: Dict[str, Any] = {}
+        # two-generation host read-buffer pool (reference: swap_tensor's
+        # pinned buffer_count pool): a generation's buffers are retired
+        # for reuse only after ITS device arrays are block_until_ready
+        # (the H2D copy has landed), and even then one generation later.
+        # Only safe when the consumer COPIES off the buffer (device_put
+        # to a real accelerator); jaxlib's CPU client can zero-copy alias
+        # numpy arrays, so CPU meshes must leave this off (the caller
+        # decides, hence the flag).
+        self._reuse = bool(reuse_buffers)
+        self._buffer_count = int(buffer_count)
+        self._free: Dict[tuple, list] = {}
+        self._last_gen: list = []
+
+    def _take_buf(self, shape, dtype) -> np.ndarray:
+        key = (tuple(shape), str(dtype))
+        lst = self._free.get(key)
+        if lst:
+            return lst.pop()
+        return np.empty(shape, dtype=np.dtype(dtype))
+
+    def _retire_gen(self, bufs: list) -> None:
+        """Rotate generations: the previous swap_in's buffers become
+        reusable now that a newer generation has fully landed."""
+        for b in self._last_gen:
+            key = (tuple(b.shape), str(b.dtype))
+            lst = self._free.setdefault(key, [])
+            if len(lst) < self._buffer_count:
+                lst.append(b)
+        self._last_gen = bufs
 
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.leaf{i}.bin")
@@ -86,10 +116,18 @@ class TensorSwapper:
                 meta = {"leaves": json.load(f)["leaves"], "treedef": treedef}
         if meta["treedef"] is None:
             raise ValueError(f"swap_in({name!r}) needs a treedef")
+        # pool only when the result leaves the numpy buffers (device_put
+        # below copies to the accelerator); a raw-tree return aliases the
+        # buffers and must never see them recycled
+        use_pool = self._reuse and shardings is not None
         bufs = []
         reqs = []
         for i, lm in enumerate(meta["leaves"]):
-            buf = np.empty(lm["shape"], dtype=np.dtype(lm["dtype"]))
+            buf = (
+                self._take_buf(lm["shape"], lm["dtype"])
+                if use_pool
+                else np.empty(lm["shape"], dtype=np.dtype(lm["dtype"]))
+            )
             reqs.append(self.aio.submit_read(self._leaf_path(name, i), buf))
             bufs.append(buf)
         for r in reqs:
@@ -97,6 +135,13 @@ class TensorSwapper:
         tree = jax.tree_util.tree_unflatten(meta["treedef"], bufs)
         if shardings is not None:
             tree = jax.device_put(tree, shardings)
+            if use_pool:
+                # the H2D transfer may be asynchronous: the buffers are
+                # only reusable once the device arrays are materialized —
+                # block on THIS generation so retiring the previous one
+                # (and any later overwrite of these) is provably safe
+                jax.block_until_ready(tree)
+                self._retire_gen(list(bufs))
         return tree
 
     def release(self, name: str) -> None:

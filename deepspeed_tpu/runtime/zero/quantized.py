@@ -146,8 +146,10 @@ def make_quantized_gather(topo, param_specs: Any, tp_specs: Any,
         def _bound(x, _axes=axes, _dim=dim, _n=n):
             return _gather_leaf(x, _axes, _dim, _n, quant_weights, quant_grads)
 
+        from ...utils.jax_compat import shard_map
+
         fns.append(
-            jax.shard_map(
+            shard_map(
                 _bound,
                 mesh=mesh,
                 in_specs=in_spec,

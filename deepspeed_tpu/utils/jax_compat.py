@@ -1,0 +1,104 @@
+"""Version portability for the handful of jax APIs that moved.
+
+The codebase targets current jax (``jax.shard_map`` with ``check_vma``,
+``jax.sharding.get_abstract_mesh``); CI images occasionally pin an older
+0.4.x jaxlib where those live under ``jax.experimental.shard_map`` /
+don't exist. Every helper here prefers the modern spelling and only falls
+back when it is absent, so behavior on current jax is byte-identical.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["axis_size", "bound_axis_names", "pallas_tpu_compiler_params",
+           "shard_map", "get_abstract_mesh"]
+
+
+def bound_axis_names(names) -> set:
+    """The subset of ``names`` currently bound as mapped (Manual) axes —
+    i.e. we are tracing inside a shard_map/pmap over them. Modern jax
+    answers this through the abstract mesh's axis types; this probe is the
+    legacy fallback (axis_frame raises NameError for unbound names).
+    Modern jax removed ``jax.core.axis_frame`` entirely — there the
+    abstract mesh is authoritative and this probe reports nothing."""
+    frame = getattr(jax.core, "axis_frame", None)
+    if frame is None:
+        return set()
+    out = set()
+    for n in names:
+        try:
+            frame(n)
+        except Exception:  # noqa: BLE001 — unbound name, any spelling
+            continue
+        out.add(n)
+    return out
+
+
+def pallas_tpu_compiler_params():
+    """The Pallas TPU CompilerParams class under its current name, or the
+    pre-rename ``TPUCompilerParams`` on 0.4.x — WITHOUT monkey-patching
+    the pltpu module (a patch would leak to every consumer in-process)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
+def axis_size(axis):
+    """``jax.lax.axis_size`` with the legacy fallback (pre-0.5 jax:
+    ``jax.core.axis_frame`` returns the static mapped-axis size)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.core.axis_frame(axis)
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """``jax.shard_map`` with the old ``jax.experimental`` fallback.
+
+    ``axis_names`` (modern: the axes the body is Manual over) translates
+    to the legacy ``auto`` parameter (its complement); ``check_vma``
+    (modern) to ``check_rep`` (legacy).
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None:
+        # legacy shard_map cannot run partial-manual programs (its eager
+        # impl raises on any `auto`, and the 0.4.x SPMD partitioner
+        # hard-aborts compiling them — CHECK IsManualSubgroup). Size-1
+        # axes are type-irrelevant (manual == auto over one shard), so
+        # only a LIVE axis outside axis_names is genuinely partial-manual
+        # — refuse it with a real error instead of a C++ abort.
+        live_auto = sorted(
+            a for a in mesh.axis_names
+            if a not in axis_names and mesh.shape[a] > 1
+        )
+        if live_auto:
+            raise NotImplementedError(
+                f"partial-manual shard_map (manual={sorted(axis_names)}, "
+                f"live auto axes={live_auto}) is unsupported on legacy "
+                "jax 0.4.x; needs jax >= 0.5"
+            )
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+
+
+def get_abstract_mesh():
+    """``jax.sharding.get_abstract_mesh()``; None where it doesn't exist
+    (legacy jax has no trace-time abstract-mesh context — callers treat
+    None as "no mesh context", their existing guard)."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    return fn() if fn is not None else None

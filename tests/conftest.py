@@ -15,9 +15,18 @@ import jax  # noqa: E402
 
 # The container's sitecustomize imports jax with JAX_PLATFORMS=axon before
 # conftest runs, so the env var alone is too late — force the config flag.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-jax.config.update("jax_threefry_partitionable", True)
+# Older jax builds lack some options (jax_num_cpu_devices landed after
+# 0.4.x); there the XLA_FLAGS host-device-count path above already covers
+# the 8-device mesh, so a missing option must not kill collection.
+for _opt, _val in (
+    ("jax_platforms", "cpu"),
+    ("jax_num_cpu_devices", 8),
+    ("jax_threefry_partitionable", True),
+):
+    try:
+        jax.config.update(_opt, _val)
+    except AttributeError:
+        pass
 
 import pytest  # noqa: E402
 

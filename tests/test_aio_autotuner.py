@@ -199,6 +199,48 @@ def test_autotuner_zero_ladder_escalates_to_fit(monkeypatch):
     assert patch["zero_optimization"]["stage"] == 3
 
 
+def test_autotuner_ladder_rung_replaces_zero_section():
+    """ADVICE r5: with tune_zero_stage forced on over an existing
+    zero_optimization section, each phase-0 probe must measure the ladder
+    rung EXACTLY — user keys like offload_optimizer must not dict.update-
+    leak into lower-stage probes (stage 0 + cpu offload is a config the
+    ladder never intends)."""
+    from deepspeed_tpu.autotuning import Autotuner
+
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+                 num_layers=2, num_heads=2)
+    tuner = Autotuner(
+        model,
+        {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "autotuning": {"tune_zero_stage": True},
+        },
+        topology=MeshTopology(dims=ParallelDims(dp=8)),
+        sample_batch_fn=lambda g: None,
+    )
+    assert tuner.tune_zero  # explicit override beats the section pin
+    tuner._zero_patch = {"stage": 0}
+    cfg = tuner._candidate_config(1, "full")
+    assert cfg["zero_optimization"] == {"stage": 0}  # rung, nothing else
+    tuner._zero_patch = {"stage": 3,
+                         "offload_optimizer": {"device": "cpu"}}
+    cfg = tuner._candidate_config(1, "full")
+    assert cfg["zero_optimization"]["offload_optimizer"]["device"] == "cpu"
+    # no patch active (phase 0 skipped/over): the user's section rides
+    tuner._zero_patch = None
+    cfg = tuner._candidate_config(1, "full")
+    assert cfg["zero_optimization"]["stage"] == 3
+    assert cfg["zero_optimization"]["offload_optimizer"]["device"] == "cpu"
+    # once settled, later phases measure rung + the user's benign keys
+    # (bucket sizes etc.) but NOT the user's stage/offload decisions
+    tuner.base_config["zero_optimization"]["reduce_bucket_size"] = 12345
+    settled = tuner._settled_zero({"stage": 1})
+    assert settled == {"stage": 1, "reduce_bucket_size": 12345}
+
+
 def test_autotuner_respects_pinned_zero_stage():
     """An explicit zero_optimization section disables phase 0 (the user's
     stage is a pin, not a starting point)."""

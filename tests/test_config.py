@@ -67,6 +67,25 @@ def test_zero_section_defaults_and_offload():
     assert cfg.zero_enabled
 
 
+def test_offload_double_buffer_knob_and_alias():
+    """offload_double_buffer defaults off (parity gate) and accepts the
+    sub_group_prefetch alias spelling."""
+    assert not DeepSpeedConfig(
+        {"zero_optimization": {"stage": 3}}
+    ).zero_config.offload_double_buffer
+    assert DeepSpeedConfig(
+        {"zero_optimization": {"stage": 3, "offload_double_buffer": True}}
+    ).zero_config.offload_double_buffer
+    assert DeepSpeedConfig(
+        {"zero_optimization": {"stage": 3, "sub_group_prefetch": True}}
+    ).zero_config.offload_double_buffer
+    # explicit key wins over the alias
+    assert not DeepSpeedConfig(
+        {"zero_optimization": {"stage": 3, "sub_group_prefetch": True,
+                               "offload_double_buffer": False}}
+    ).zero_config.offload_double_buffer
+
+
 def test_offload_param_requires_stage3():
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig(

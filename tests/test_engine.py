@@ -364,6 +364,90 @@ def test_bucketed_offload_update_matches_plain(devices8):
     np.testing.assert_allclose(l_next, l_again, rtol=1e-6)
 
 
+def test_bucketed_double_buffer_matches_serial_and_plain(devices8):
+    """The double-buffered layer stream (zero_optimization.
+    offload_double_buffer) runs the same per-layer math in the same order
+    as the serial bucketed scan — the CPU-mesh oracle demands trajectories
+    identical to BOTH the serial bucketed path and the whole-tree optax
+    update before the knob may ever default on."""
+    base = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+    }
+    plain_losses, plain = _run_steps(
+        {**base, "zero_optimization": {"stage": 3}}, steps=4, vary_data=True
+    )
+    off = {"stage": 3, "offload_optimizer": {"device": "cpu"}}
+    serial_losses, serial = _run_steps(
+        {**base, "zero_optimization": dict(off)}, steps=4, vary_data=True
+    )
+    db_losses, db = _run_steps(
+        {**base,
+         "zero_optimization": dict(off, offload_double_buffer=True)},
+        steps=4, vary_data=True,
+    )
+    assert db._bucketed_opt is not None and db._bucketed_opt.double_buffer
+    assert serial._bucketed_opt is not None
+    assert not serial._bucketed_opt.double_buffer
+    # CPU meshes have no memory kinds: nothing streams, nothing recorded
+    assert db.offload_stream is None
+    # double-buffered == serial bucketed, leaf by leaf
+    np.testing.assert_allclose(db_losses, serial_losses, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(serial.state.params),
+                    jax.tree_util.tree_leaves(db.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # == the plain whole-tree update at f32 tolerance
+    np.testing.assert_allclose(db_losses, plain_losses, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(plain.state.params),
+                    jax.tree_util.tree_leaves(db.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_bucketed_disabled_when_layer_dim_dp_sharded(devices8):
+    """ADVICE r5: _bucketed_slice_put's drop_lead assumes the stacked
+    leaves' dim 0 (the layer dim) is unsharded. When L is the largest
+    dp-divisible dim (tiny hidden sizes), add_data_axes shards dim 0 and
+    the slice hooks could not round-trip the resting sharding — the
+    engine must fall back to the whole-tree update, not silently break
+    the chain's carry closure."""
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16,
+                 hidden_size=12, num_layers=8, num_heads=2,
+                 intermediate_size=12)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 0,
+            "offload_optimizer": {"device": "cpu"},
+        },
+    }
+    comm.destroy_process_group()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=cfg, rng=jax.random.PRNGKey(0)
+    )
+    # sanity: the guard really saw a dim-0-sharded stacked leaf
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.runtime.bucketed_opt import stacked_dim0_unsharded
+
+    assert not stacked_dim0_unsharded(engine.param_specs["layers"])
+    assert engine._bucketed_opt is None
+    loss = float(engine.train_batch(
+        batch={"input_ids": np.random.RandomState(0).randint(
+            0, 64, size=(8, 16))}))
+    assert np.isfinite(loss)
+    # the predicate itself: dim-0 entries disable, others don't
+    assert stacked_dim0_unsharded({"w": P(None, "dp")})
+    assert stacked_dim0_unsharded({"w": P()})
+    assert not stacked_dim0_unsharded({"w": P("dp", None)})
+    assert not stacked_dim0_unsharded({"ok": P(None)}, {"bad": P(("dp", "fsdp"))})
+
+
 def test_bucketed_step_with_placement_hooks_matches_plain(devices8):
     """The bucketed update with per-slice placement hooks installed (the
     TPU-offload configuration) is numerically identical to the hookless
@@ -392,3 +476,36 @@ def test_bucketed_step_with_placement_hooks_matches_plain(devices8):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_bucketed_double_buffer_step_bitmatches_serial_scan():
+    """Unit oracle for the software-pipelined step: with and without
+    placement hooks, the two-slot rotating-buffer scan must produce
+    exactly the serial scan's params and state (same math, same layer
+    order — only the schedule differs)."""
+    import optax
+
+    from deepspeed_tpu.runtime.bucketed_opt import BucketedOptimizer
+
+    r = np.random.RandomState(0)
+    params = {
+        "layers": {"w": jnp.asarray(r.randn(6, 8, 8), jnp.float32),
+                   "b": jnp.asarray(r.randn(6, 8), jnp.float32)},
+        "embed": jnp.asarray(r.randn(16, 8), jnp.float32),
+    }
+    grads = jax.tree.map(lambda x: jnp.asarray(
+        np.random.RandomState(1).randn(*x.shape), jnp.float32), params)
+    serial = BucketedOptimizer(optax.adamw(1e-2))
+    pipelined = BucketedOptimizer(optax.adamw(1e-2), double_buffer=True)
+    st = jax.jit(serial.init)(params)
+    ident = (lambda t: t, lambda t: t)
+    want = jax.jit(serial.step)(grads, st, params)
+    for hooks in (None, ident):
+        got = jax.jit(
+            lambda g, s, p, h=hooks: pipelined.step(
+                g, s, p, state_put=h, param_put=h
+            )
+        )(grads, st, params)
+        for a, b in zip(jax.tree_util.tree_leaves(want),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

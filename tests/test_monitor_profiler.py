@@ -65,6 +65,32 @@ def test_comms_logger_records_shard_map_ops():
     assert logger.bytes["all_reduce"] == 2 * 4 * 4
 
 
+def test_comms_logger_offload_stream_accounting():
+    """The bucketed ZeRO-offload DMA stream is not a collective — the
+    engine reports it per step; the logger must aggregate bytes, expose
+    the in-flight (slots × slice) peak, and render an offload line in
+    the summary."""
+    logger = CommsLogger()
+    try:
+        logger.record_offload(100, 100, slots=2, slot_bytes=10, steps=3)
+        assert logger.offload_steps == 3
+        assert logger.offload_bytes_in == 300
+        assert logger.offload_bytes_out == 300
+        assert logger.offload_bytes_in_flight == 20
+        s = logger.summary(duration_s=1.0)
+        assert "offload stream" in s
+        assert "2 slot(s)" in s
+        # no offload recorded → no offload line
+        assert "offload stream" not in CommsLogger().summary(duration_s=1.0)
+    finally:
+        logger.stop()
+    # overlap-ratio arithmetic: (serial - overlapped) / dma, clamped [0,1]
+    assert CommsLogger.offload_overlap_ratio(4.0, 3.0, 2.0) == 0.5
+    assert CommsLogger.offload_overlap_ratio(4.0, 4.5, 2.0) == 0.0
+    assert CommsLogger.offload_overlap_ratio(4.0, 1.0, 2.0) == 1.0
+    assert CommsLogger.offload_overlap_ratio(4.0, 3.0, 0.0) == 0.0
+
+
 def test_get_bw_formulas():
     alg, bus = get_bw("all_reduce", 1e9, 1.0, 4)
     assert abs(alg - 8.0) < 1e-9

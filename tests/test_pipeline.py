@@ -193,6 +193,15 @@ def make_engines():
     return piped, dense
 
 
+_OLD_JAX = tuple(map(int, jax.__version__.split(".")[:2])) < (0, 5)
+
+
+@pytest.mark.skipif(
+    _OLD_JAX,
+    reason="jaxlib 0.4.x's CPU compiler hard-aborts (SIGABRT, no Python "
+    "error) on the compiled pipeline schedule, killing the whole pytest "
+    "process and every test after it",
+)
 def test_pipeline_engine_parity_with_dense():
     piped, dense = make_engines()
     from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
