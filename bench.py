@@ -79,17 +79,20 @@ def bench_dims(smoke: bool):
     return (max(16384 // seq, 1), seq)
 
 
-def bench_model_and_data(smoke: bool):
-    """The benchmark model: ONE definition shared by bench.py and the
-    operator sweep (tools/sweep_train.py) so "best sweep config" always
-    refers to the model the bench reports.
+def bench_model(smoke: bool, tag: str = None):
+    """The benchmark model: ONE definition shared by bench.py, the
+    operator sweep (tools/sweep_train.py) and the shardlint gate
+    (tools/shardlint.py --all-examples) so "best sweep config" and "the
+    linted leg" always refer to the model the bench reports.
 
     head_dim=128 matches the MXU lane width (hd=64 runs the attention
     matmuls at half MXU utilization: measured 1.6x slower end-to-end)."""
     from deepspeed_tpu.models import llama
 
     B, S = bench_dims(smoke)
-    if not smoke and model_tag() == "1b":
+    if tag is None:
+        tag = model_tag()
+    if not smoke and tag == "1b":
         # ~1.4B params: bf16 weights+grads ~5.6 GB fit the 16 GB v5e, the
         # fp32 adam m/v + master (~17 GB) do NOT — precisely the shape
         # ZeRO-3 + pinned_host optimizer offload exists for
@@ -116,12 +119,59 @@ def bench_model_and_data(smoke: bool):
             head_dim=16 if smoke else 128,
             intermediate_size=512 if smoke else 4096,
         )
+    return model, B, S
+
+
+def bench_model_and_data(smoke: bool):
+    """(model, data, B, S) — bench_model plus the fixed random batch."""
+    model, B, S = bench_model(smoke)
     data = {
         "input_ids": np.random.RandomState(0).randint(
             0, model.config.vocab_size, size=(B, S)
         )
     }
     return model, data, B, S
+
+
+def make_ds_config(B, zero, pol, micro, tk):
+    """ONE config builder for the ladder, the offload A/B rebuild AND the
+    shardlint bench legs — separate inline dicts would silently drift
+    apart as keys are added."""
+    return {
+        "train_batch_size": B,
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": zero,
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+        "activation_checkpointing": {"policy": pol},
+        "tpu_kernels": tk,
+    }
+
+
+def lint_targets(dp: int):
+    """(name, model, ds_config) for the bench legs shardlint gates (the
+    acceptance surface of ISSUE 2): the 410m leg and the 1.5B ZeRO-3 +
+    pinned-host-offload leg, serial and double-buffered. Models are
+    config shells only — shardlint traces them abstractly, nothing is
+    materialized, so the 1.4B leg lints in seconds on CPU."""
+    model_410m, B, _S = bench_model(smoke=False, tag="410m")
+    model_1b, _B1, _S1 = bench_model(smoke=False, tag="1b")
+    B = -(-B // dp) * dp  # same dp-divisibility round-up as main()
+    micro = max(B // dp, 1)
+    tiles = {"flash_block_q": 512, "flash_block_k": 1024}
+    offload = {"stage": 3, "offload_optimizer": {"device": "cpu"},
+               "offload_param": {"device": "cpu"}}
+    return [
+        ("bench-410m", model_410m,
+         make_ds_config(B, {"stage": 0}, "none", micro, {})),
+        ("bench-1b-offload", model_1b,
+         make_ds_config(B, dict(offload), "dots_flash", 1, tiles)),
+        ("bench-1b-offload-db", model_1b,
+         make_ds_config(B, dict(offload, offload_double_buffer=True),
+                        "dots_flash", 1, tiles)),
+    ]
 
 
 def time_chained_steps(engine, data, chain: int = 5, trials: int = 3) -> float:
@@ -153,7 +203,10 @@ def offload_report(engine, step_s: float):
         return None
     bw = float(os.environ.get("BENCH_HOST_BW_GBS", 32)) * 1e9  # bytes/s
     total = off["bytes_in"] + off["bytes_out"]
-    dma_s = total / bw
+    # a zero/negative bandwidth override (or an empty stream) must not
+    # kill the bench on its accounting line; 0s DMA reads as "nothing to
+    # hide" downstream (offload_overlap_ratio guards the same way)
+    dma_s = total / bw if bw > 0 else 0.0
     return {
         "gib_per_step": round(total / 2**30, 2),
         "in_flight_mib": round(off["slots"] * off["slot_bytes"] / 2**20, 1),
@@ -281,19 +334,7 @@ def main():
         ladder = [(pol, mb, {**tk, "fused_adam": True})
                   for pol, mb, tk in ladder]
     def ds_config(zero, pol, micro, tk):
-        """ONE config builder for the ladder and the offload A/B rebuild —
-        two inline dicts would silently drift apart as keys are added."""
-        return {
-            "train_batch_size": B,
-            "train_micro_batch_size_per_gpu": micro,
-            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": True},
-            "zero_optimization": zero,
-            "gradient_clipping": 1.0,
-            "steps_per_print": 1000,
-            "activation_checkpointing": {"policy": pol},
-            "tpu_kernels": tk,
-        }
+        return make_ds_config(B, zero, pol, micro, tk)
 
     engine = None
     last_err = None

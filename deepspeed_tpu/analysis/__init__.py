@@ -1,0 +1,35 @@
+"""Static analysis of jitted step programs (shardlint).
+
+The correctness contract of sharded training is a small set of checkable
+invariants on the collective/partition structure of the step program
+(ZeRO++ arXiv:2306.10209; automatic cross-replica sharding
+arXiv:2004.13336). This package traces engine step functions to jaxprs —
+abstract evaluation only, no device execution — and lints them against a
+rule registry:
+
+- R1 replica-divergence  (rules/replica.py)
+- R2 sharding-closure    (rules/closure.py)
+- R3 collective-topology (rules/topology.py)
+- R4 donation/aliasing   (rules/aliasing.py)
+- R5 precision-policy    (rules/precision.py)
+
+Entry points: :func:`lint_jaxpr` (any program), :func:`lint_engine` (a
+constructed engine, including ``abstract_init=True`` shells that never
+materialized state), :func:`lint_config` (config → abstract engine →
+lint). CLI: ``tools/shardlint.py``. Rule catalog: ``docs/shardlint.md``.
+"""
+
+from .base import Finding, LintContext, Report
+from .rules import register_rule, registered_rules
+from .shardlint import lint_config, lint_engine, lint_jaxpr
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "Report",
+    "lint_config",
+    "lint_engine",
+    "lint_jaxpr",
+    "register_rule",
+    "registered_rules",
+]

@@ -1,0 +1,148 @@
+"""Shared vocabulary of the shardlint subsystem: findings, context, report.
+
+Kept separate from shardlint.py so rule modules (analysis/rules/*) can
+import it without a circular import through the driver.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass
+class Finding:
+    """One hazard surfaced by a rule.
+
+    rule: registry id ("R2"); severity: "error" | "warning"; where: a
+    jaxpr path like "/scan/shard_map" locating the offending equation;
+    source: which linted program produced it (engine/config/fixture name).
+    """
+
+    rule: str
+    severity: str
+    message: str
+    where: str = ""
+    source: str = ""
+
+    def format(self) -> str:
+        loc = f" @ {self.where}" if self.where else ""
+        return f"[{self.rule}:{self.severity}] {self.source}{loc}: {self.message}"
+
+    def to_dict(self) -> Dict[str, str]:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "where": self.where,
+            "source": self.source,
+        }
+
+
+@dataclass
+class LintContext:
+    """Everything a rule may consult about one traced program.
+
+    closed_jaxpr: the program (jax.core.ClosedJaxpr).
+    mesh: the authoritative mesh the program is expected to run on
+        (engine topology mesh); rules compare embedded shard_map meshes
+        against it. None → skip mesh-agreement checks.
+    arg_shardings: Var → sharding for top-level invars whose placement is
+        known (from ShapeDtypeStruct shardings / engine state shardings).
+        Duck-typed: rules only read ``.spec`` / ``.memory_kind``.
+    master_pairs: (invar_index, outvar_index, label) triples naming f32
+        master-state leaves that must round-trip the step at full
+        precision (R5).
+    source: display name for findings.
+
+    (Donation hazards need no context field: R4 reads each pjit
+    equation's own ``donated_invars`` param, and the jit-boundary
+    donation audit lives in shardlint.lint_engine, which has the engine.)
+    """
+
+    closed_jaxpr: Any
+    mesh: Any = None
+    arg_shardings: Dict[Any, Any] = field(default_factory=dict)
+    master_pairs: Sequence[Tuple[int, int, str]] = ()
+    source: str = "<jaxpr>"
+
+    @property
+    def jaxpr(self):
+        return self.closed_jaxpr.jaxpr
+
+    def mesh_axis_sizes(self) -> Dict[str, int]:
+        if self.mesh is None:
+            return {}
+        try:
+            return dict(self.mesh.shape)
+        except Exception:  # noqa: BLE001 — AbstractMesh et al.
+            return {}
+
+
+class Report:
+    """Aggregated findings over one or more linted sources."""
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self.sources: List[Dict[str, Any]] = []
+
+    def add_source(self, name: str, seconds: float, n_findings: int,
+                   skipped: Optional[str] = None) -> None:
+        self.sources.append({
+            "source": name,
+            "seconds": round(float(seconds), 3),
+            "findings": int(n_findings),
+            **({"skipped": skipped} if skipped else {}),
+        })
+
+    def extend(self, findings: Sequence[Finding]) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "sources": list(self.sources),
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    def format(self) -> str:
+        lines = []
+        for s in self.sources:
+            status = s.get("skipped") and f"SKIPPED ({s['skipped']})" or (
+                f"{s['findings']} finding(s)"
+            )
+            lines.append(
+                f"shardlint: {s['source']}: {status} in {s['seconds']:.2f}s"
+            )
+        lines.extend(f.format() for f in self.findings)
+        lines.append(
+            "shardlint: "
+            + ("CLEAN" if self.ok else f"{len(self.errors)} error finding(s)")
+        )
+        return "\n".join(lines)
+
+
+def sharding_fingerprint(s) -> Optional[Tuple[str, str]]:
+    """Comparable identity of a sharding for closure checks: (spec,
+    memory kind). None when ``s`` carries no partition spec (single-device
+    shardings, raw Device objects) — those never participate in a
+    closure comparison."""
+    spec = getattr(s, "spec", None)
+    if spec is None:
+        return None
+    return (str(spec), str(getattr(s, "memory_kind", None)))

@@ -1,0 +1,66 @@
+"""Shardlint rule registry.
+
+A rule is a function ``(ctx: LintContext) -> list[Finding]`` registered
+under a stable id. Adding a rule (docs/shardlint.md "adding a rule"):
+
+    from ..base import Finding, LintContext
+    from . import register_rule
+
+    @register_rule("R9", "my-hazard")
+    def my_rule(ctx: LintContext):
+        return [...]
+
+The built-in modules below self-register on import.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..base import Finding, LintContext
+
+_RULES: Dict[str, "RuleEntry"] = {}
+
+
+class RuleEntry:
+    def __init__(self, rule_id: str, title: str, fn: Callable):
+        self.rule_id = rule_id
+        self.title = title
+        self.fn = fn
+
+    def __call__(self, ctx: LintContext) -> List[Finding]:
+        return list(self.fn(ctx))
+
+
+def register_rule(rule_id: str, title: str):
+    def deco(fn):
+        if rule_id in _RULES:
+            raise ValueError(f"shardlint rule {rule_id!r} already registered")
+        _RULES[rule_id] = RuleEntry(rule_id, title, fn)
+        return fn
+
+    return deco
+
+
+def registered_rules() -> Dict[str, RuleEntry]:
+    return dict(_RULES)
+
+
+def run_rules(ctx: LintContext,
+              only: Optional[Sequence[str]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for rid, entry in sorted(_RULES.items()):
+        if only is not None and rid not in only:
+            continue
+        for f in entry(ctx):
+            f.source = f.source or ctx.source
+            out.append(f)
+    return out
+
+
+# built-in rules (import order == catalog order)
+from . import replica  # noqa: E402,F401  (R1)
+from . import closure  # noqa: E402,F401  (R2)
+from . import topology  # noqa: E402,F401  (R3)
+from . import aliasing  # noqa: E402,F401  (R4)
+from . import precision  # noqa: E402,F401  (R5)

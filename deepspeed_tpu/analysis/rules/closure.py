@@ -1,0 +1,164 @@
+"""R2 — sharding-closure.
+
+A scanned step (grad-accum scan, bucketed per-layer optimizer scan,
+train_batch_chain) is only correct if every loop carry comes back with
+the sharding it went in with: a carry whose writeback restores a
+*different* placement than the carry-in either forces a silent reshard
+every tick or — with host memory kinds — migrates state off its resting
+memory space (the PR-1 stacked-dim-0 drift class).
+
+Statically visible sharding evidence is collected per jaxpr level:
+
+- top-level invars with known arg shardings;
+- ``device_put`` / ``sharding_constraint`` equation outputs (their
+  sharding is an eqn param).
+
+For every scan/while carry where BOTH the carry-in and the body's
+carry-out producer have evidence, the two fingerprints (spec, memory
+kind) must agree. Unknown placements are never flagged (XLA is free to
+choose them consistently).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..base import ERROR, Finding, LintContext, sharding_fingerprint
+from ..trace import Jaxpr, Literal, as_jaxpr, producers, scan_split
+from . import register_rule
+
+
+def _lookup(known: Dict[Any, Any], var) -> Optional[Any]:
+    if isinstance(var, Literal):
+        return None
+    return known.get(var)
+
+
+def _eqn_out_sharding(eqn, outvar) -> Optional[Any]:
+    """The sharding an eqn pins its output to, if it pins one."""
+    name = eqn.primitive.name
+    if name == "sharding_constraint":
+        return eqn.params.get("sharding")
+    if name == "device_put":
+        devices = eqn.params.get("devices") or ()
+        try:
+            idx = list(eqn.outvars).index(outvar)
+        except ValueError:
+            return None
+        if idx < len(devices):
+            d = devices[idx]
+            if sharding_fingerprint(d) is not None:
+                return d
+    return None
+
+
+def _check_loop_carries(kind: str, body: Jaxpr, carry_invars,
+                        body_carry_outvars, known: Dict[Any, Any],
+                        sub_path: str, findings: List[Finding]) -> None:
+    """Shared scan/while carry check: for each carry with evidence on
+    BOTH ends, the fingerprints must match."""
+    body_prod = producers(body)
+    for k, (carry_in, body_out) in enumerate(
+        zip(carry_invars, body_carry_outvars)
+    ):
+        s_in = _lookup(known, carry_in)
+        out_eqn = body_prod.get(body_out)
+        s_out = (
+            _eqn_out_sharding(out_eqn, body_out)
+            if out_eqn is not None
+            else None
+        )
+        if s_in is None or s_out is None:
+            continue
+        fp_in = sharding_fingerprint(s_in)
+        fp_out = sharding_fingerprint(s_out)
+        if fp_in is not None and fp_out is not None and fp_in != fp_out:
+            findings.append(Finding(
+                rule="R2",
+                severity=ERROR,
+                message=(
+                    f"{kind} carry #{k}: carry-in sharding {fp_in[0]} "
+                    f"(memory {fp_in[1]}) != carry-out writeback "
+                    f"{fp_out[0]} (memory {fp_out[1]}) — the loop "
+                    "re-shards its state every tick (carry-in == "
+                    "carry-out closure violated)"
+                ),
+                where=sub_path,
+            ))
+
+
+def _map_known(known: Dict[Any, Any], outer_vars, body_invars) -> Dict[Any, Any]:
+    body_known: Dict[Any, Any] = {}
+    for outer, inner in zip(outer_vars, body_invars):
+        s = _lookup(known, outer)
+        if s is not None:
+            body_known[inner] = s
+    return body_known
+
+
+def _check_jaxpr(jaxpr: Jaxpr, known: Dict[Any, Any], path: str,
+                 findings: List[Finding]) -> None:
+    # extend the evidence map with this level's placement pins
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            s = _eqn_out_sharding(eqn, ov)
+            if s is not None:
+                known[ov] = s
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_path = f"{path}/{name}"
+        if name == "scan":
+            body = as_jaxpr(eqn.params["jaxpr"])
+            nc, ncar = scan_split(eqn)
+            _check_loop_carries(
+                "scan", body, eqn.invars[nc:nc + ncar],
+                body.outvars[:ncar], known, sub_path, findings,
+            )
+            _check_jaxpr(
+                body,
+                _map_known(known, eqn.invars[:nc + ncar], body.invars),
+                sub_path, findings,
+            )
+        elif name == "while":
+            body = as_jaxpr(eqn.params["body_jaxpr"])
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            ncar = len(eqn.invars) - cn - bn
+            _check_loop_carries(
+                "while", body, eqn.invars[cn + bn:],
+                body.outvars[:ncar], known, sub_path, findings,
+            )
+            _check_jaxpr(
+                body,
+                _map_known(known, eqn.invars[cn:], body.invars),
+                sub_path, findings,
+            )
+        else:
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "branches",
+                        "cond_jaxpr"):
+                v = eqn.params.get(key)
+                subs = v if isinstance(v, (list, tuple)) else [v]
+                for s in subs:
+                    if s is None:
+                        continue
+                    body = as_jaxpr(s)
+                    body_known = (
+                        _map_known(known, eqn.invars, body.invars)
+                        if len(body.invars) == len(eqn.invars)
+                        else {}
+                    )
+                    _check_jaxpr(body, body_known, f"{sub_path}.{key}",
+                                 findings)
+
+
+@register_rule("R2", "sharding-closure")
+def sharding_closure(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    known = {
+        v: s
+        for v, s in ctx.arg_shardings.items()
+        if s is not None and sharding_fingerprint(s) is not None
+    }
+    _check_jaxpr(ctx.jaxpr, known, "", findings)
+    return findings

@@ -1,0 +1,155 @@
+"""R5 — precision-policy.
+
+(a) master-weight preservation: an f32 master leaf (params / optimizer
+    m/v) must reach its step output along at least one path that never
+    drops below 32-bit float. Casting masters to bf16 for *compute* is
+    the policy (the result arrives back as an update term); rebuilding
+    the stored master itself from a truncated copy is the bug — after
+    ~1k steps the master is a bf16 weight in f32 clothing. The analysis
+    computes the "preserved" set: values reachable from a master input
+    through ops whose output keeps ≥ f32 float width; a master output
+    outside the set has *every* path truncated.
+
+(b) pinned-host compute: a value whose placement evidence says
+    ``pinned_host`` may only flow through placement/slicing ops before an
+    explicit copy to device memory; feeding it straight into compute
+    (dot_general, elementwise math) either fails to compile or silently
+    runs the op on the host CPU at host-DRAM speed.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..base import ERROR, Finding, LintContext
+from ..trace import DataflowAnalysis
+from . import register_rule
+
+_F32_BITS = 32
+
+
+def _is_wide_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating) and jnp.finfo(dtype).bits >= _F32_BITS
+
+
+class _Preserved(DataflowAnalysis):
+    """True == value carries a full-precision copy of some master leaf."""
+
+    def transfer(self, eqn, in_vals: List[bool]) -> List[bool]:
+        out = []
+        for ov in eqn.outvars:
+            dtype = getattr(getattr(ov, "aval", None), "dtype", None)
+            ok = (
+                any(in_vals)
+                and dtype is not None
+                and _is_wide_float(dtype)
+            )
+            out.append(ok)
+        return out
+
+
+# ops through which host-resident bytes may legally flow before the
+# explicit device copy (placement, layout, slicing — no arithmetic)
+_HOST_OK = {
+    "device_put", "copy", "slice", "dynamic_slice", "squeeze", "reshape",
+    "transpose", "broadcast_in_dim", "concatenate", "gather", "rev",
+    "expand_dims", "pad",
+}
+
+
+class _PinnedHost(DataflowAnalysis):
+    def __init__(self, emit, pinned_kinds=("pinned_host",)):
+        self.emit = emit
+        self.pinned_kinds = pinned_kinds
+        self._reported = set()
+
+    def _device_put_kinds(self, eqn) -> List[bool]:
+        out = []
+        for i, _ov in enumerate(eqn.outvars):
+            devices = eqn.params.get("devices") or ()
+            kind = (
+                getattr(devices[i], "memory_kind", None)
+                if i < len(devices)
+                else None
+            )
+            out.append(kind in self.pinned_kinds)
+        return out
+
+    def transfer(self, eqn, in_vals: List[bool]) -> List[bool]:
+        if eqn.primitive.name == "device_put":
+            return self._device_put_kinds(eqn)
+        return [any(in_vals)] * len(eqn.outvars)
+
+    def visit(self, eqn, in_vals, out_vals, path) -> None:
+        from ..trace import eqn_subjaxprs
+
+        name = eqn.primitive.name
+        if name in _HOST_OK or not any(in_vals):
+            return
+        if eqn_subjaxprs(eqn):
+            return  # control-flow: the recursion checks the body eqns
+        key = (path, name)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.emit(Finding(
+            rule="R5",
+            severity=ERROR,
+            message=(
+                f"pinned_host-resident value feeds {name} without an "
+                "explicit copy to device memory — host-speed compute (or "
+                "a compile failure) instead of a scheduled DMA"
+            ),
+            where=f"{path}/{name}",
+        ))
+
+
+@register_rule("R5", "precision-policy")
+def precision_policy(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    jaxpr = ctx.jaxpr
+
+    # (a) master preservation
+    if ctx.master_pairs:
+        invars = list(jaxpr.invars)
+        seeds = [False] * len(invars)
+        master_in = {}
+        for in_idx, _out_idx, label in ctx.master_pairs:
+            if 0 <= in_idx < len(seeds):
+                seeds[in_idx] = True
+                master_in[in_idx] = label
+        out_vals = _Preserved().run(jaxpr, seeds, "")
+        for in_idx, out_idx, label in ctx.master_pairs:
+            if not (0 <= out_idx < len(out_vals)):
+                continue
+            ov = jaxpr.outvars[out_idx]
+            dtype = getattr(getattr(ov, "aval", None), "dtype", None)
+            if dtype is None or not _is_wide_float(dtype):
+                continue  # not a wide-float output: out of scope
+            if not out_vals[out_idx]:
+                findings.append(Finding(
+                    rule="R5",
+                    severity=ERROR,
+                    message=(
+                        f"master-state leaf {label!r}: every path from the "
+                        "f32 input to the f32 output passes through a "
+                        "sub-32-bit float — the stored master is rebuilt "
+                        "from truncated data (bf16-in-f32-clothing drift)"
+                    ),
+                    where="",
+                ))
+
+    # (b) pinned-host consumption
+    seeds = []
+    pinned_any = False
+    for v in jaxpr.invars:
+        s = ctx.arg_shardings.get(v)
+        pinned = getattr(s, "memory_kind", None) == "pinned_host"
+        pinned_any = pinned_any or pinned
+        seeds.append(pinned)
+    # even with no pinned inputs, device_put eqns can introduce pinned
+    # values mid-program, so the pass always runs (it is cheap)
+    _PinnedHost(findings.append).run(jaxpr, seeds, "")
+    return findings

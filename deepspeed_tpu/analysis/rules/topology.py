@@ -1,0 +1,172 @@
+"""R3 — collective-topology.
+
+ppermute is the pipeline's p2p fabric (runtime/pipe/schedule.py): on real
+ICI a malformed permutation is not a wrong answer but a *hang* — a member
+waiting on a source that never sends. Statically checkable properties of
+the ``perm`` parameter:
+
+- every (src, dst) within [0, axis_size);
+- no duplicate sources or destinations (XLA requires a partial
+  permutation; duplicates deadlock or drop data);
+- no self-loops (a member sending to itself deadlocks some transports);
+- cycle structure: a perm containing a cycle must be exactly ONE cycle
+  covering the whole axis (a full ring). Disjoint sub-rings or a ring
+  plus stray edges desynchronize members. Pure chains (the pipeline's
+  neighbor hop, no wraparound) are legal.
+
+Also checked: named collectives must use axes bound by the enclosing
+shard_map, and every embedded shard_map mesh must agree with the
+authoritative lint mesh (axis names and sizes) — a shard_map traced over
+a stale mesh is invisible at runtime until the wrong collective fires.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..base import ERROR, Finding, LintContext
+from ..trace import (
+    as_jaxpr,
+    collective_axes,
+    eqn_subjaxprs,
+    shard_map_manual_axes,
+)
+from . import register_rule
+
+_NAMED_COLLECTIVES = {
+    "psum", "pmin", "pmax", "ppermute", "all_gather", "all_to_all",
+    "psum_scatter", "pbroadcast", "axis_index",
+}
+
+
+def check_permutation(perm, axis_size: int) -> List[str]:
+    """Problems with a ppermute permutation (empty list == well-formed).
+
+    Exposed for reuse: runtime/pipe/schedule.py builds its neighbor hop
+    against this contract.
+    """
+    problems: List[str] = []
+    pairs = [tuple(p) for p in perm]
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    oob = [p for p in pairs if not (0 <= p[0] < axis_size
+                                    and 0 <= p[1] < axis_size)]
+    if oob:
+        problems.append(f"out-of-range pairs {oob} for axis size {axis_size}")
+    if len(set(srcs)) != len(srcs):
+        problems.append("duplicate sources (a member sends twice)")
+    if len(set(dsts)) != len(dsts):
+        problems.append("duplicate destinations (two members send to one)")
+    self_loops = [p for p in pairs if p[0] == p[1]]
+    if self_loops:
+        problems.append(f"self-loops {self_loops}")
+    if problems:
+        return problems
+    # cycle structure: an injective partial map decomposes into disjoint
+    # simple paths (legal: the pipeline's neighbor hop) and simple cycles
+    nxt = dict(pairs)
+    dsts_set = set(dsts)
+    visited = set()
+    for start in [s for s in nxt if s not in dsts_set]:  # chain starts
+        cur = start
+        while cur in nxt and cur not in visited:
+            visited.add(cur)
+            cur = nxt[cur]
+    cycles = []
+    for s in nxt:
+        if s in visited:
+            continue
+        cyc, cur = [s], nxt[s]
+        visited.add(s)
+        while cur != s:
+            visited.add(cur)
+            cyc.append(cur)
+            cur = nxt[cur]
+        cycles.append(cyc)
+    if len(cycles) > 1:
+        problems.append(
+            f"{len(cycles)} disjoint rings {sorted(cycles)} — members "
+            "desynchronize across rings"
+        )
+    elif len(cycles) == 1 and len(pairs) != len(cycles[0]):
+        problems.append(
+            "a ring plus stray chain edges — malformed permutation"
+        )
+    elif len(cycles) == 1 and len(cycles[0]) != axis_size:
+        problems.append(
+            f"partial ring over {len(cycles[0])}/{axis_size} members "
+            f"{sorted(cycles[0])} — the others never participate"
+        )
+    return problems
+
+
+def _walk(jaxpr, axis_env: Dict[str, int], path: str, ctx: LintContext,
+          findings: List[Finding]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_path = f"{path}/{name}"
+        if name == "shard_map":
+            manual = shard_map_manual_axes(eqn)
+            lint_sizes = ctx.mesh_axis_sizes()
+            if lint_sizes:
+                mismatched = [
+                    (a, n, lint_sizes.get(a))
+                    for a, n in manual.items()
+                    if lint_sizes.get(a) != n
+                ]
+                if mismatched:
+                    findings.append(Finding(
+                        rule="R3",
+                        severity=ERROR,
+                        message=(
+                            "shard_map mesh disagrees with the engine mesh: "
+                            + ", ".join(
+                                f"axis {a!r} size {n} (engine: {m})"
+                                for a, n, m in mismatched
+                            )
+                        ),
+                        where=sub_path,
+                    ))
+            _walk(as_jaxpr(eqn.params["jaxpr"]), {**axis_env, **manual},
+                  sub_path, ctx, findings)
+            continue
+        if name in _NAMED_COLLECTIVES:
+            for a in collective_axes(eqn):
+                if a not in axis_env:
+                    findings.append(Finding(
+                        rule="R3",
+                        severity=ERROR,
+                        message=(
+                            f"{name} over axis {a!r} which is not bound by "
+                            "any enclosing shard_map mesh (bound: "
+                            f"{sorted(axis_env) or 'none'})"
+                        ),
+                        where=sub_path,
+                    ))
+            if name == "ppermute":
+                axes: List[Tuple[str, int]] = [
+                    (a, axis_env[a]) for a in collective_axes(eqn)
+                    if a in axis_env
+                ]
+                for a, size in axes:
+                    for problem in check_permutation(
+                        eqn.params.get("perm") or (), size
+                    ):
+                        findings.append(Finding(
+                            rule="R3",
+                            severity=ERROR,
+                            message=(
+                                f"ppermute over {a!r}: {problem} — hangs "
+                                "or deadlocks on real ICI"
+                            ),
+                            where=sub_path,
+                        ))
+        for _k, sub in eqn_subjaxprs(eqn):
+            _walk(sub, axis_env, sub_path, ctx, findings)
+
+
+@register_rule("R3", "collective-topology")
+def collective_topology(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    _walk(ctx.jaxpr, {}, "", ctx, findings)
+    return findings

@@ -1,0 +1,239 @@
+"""shardlint driver: trace → context → rules → report.
+
+Three entry points, all CPU-cheap (abstract evaluation only):
+
+- :func:`lint_jaxpr` — lint any program you already traced.
+- :func:`lint_engine` — trace a constructed engine's jitted train step
+  (works on ``abstract_init=True`` shells whose state is
+  ShapeDtypeStructs) and lint it, plus engine-level closure/donation
+  audits the jaxpr alone cannot express.
+- :func:`lint_config` — ds_config (+ model) → abstract engine → lint.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import ERROR, WARNING, Finding, LintContext, Report, sharding_fingerprint
+from .rules import run_rules
+
+
+def lint_jaxpr(
+    closed_jaxpr,
+    *,
+    mesh=None,
+    arg_shardings: Optional[Dict[Any, Any]] = None,
+    master_pairs: Sequence = (),
+    source: str = "<jaxpr>",
+    only: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run the rule registry over one traced program."""
+    ctx = LintContext(
+        closed_jaxpr=closed_jaxpr,
+        mesh=mesh,
+        arg_shardings=arg_shardings or {},
+        master_pairs=tuple(master_pairs),
+        source=source,
+    )
+    return run_rules(ctx, only=only)
+
+
+# --------------------------------------------------------------- engine lint
+def _leaf_sharding(leaf):
+    return getattr(leaf, "sharding", None)
+
+
+def _as_sds(leaf):
+    """Array/ShapeDtypeStruct → ShapeDtypeStruct preserving sharding."""
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        return leaf
+    return jax.ShapeDtypeStruct(
+        leaf.shape, leaf.dtype, sharding=_leaf_sharding(leaf)
+    )
+
+
+def _batch_sds(engine):
+    cfg = engine.config
+    accum = cfg.gradient_accumulation_steps
+    B = cfg.train_batch_size
+    S = getattr(getattr(engine.model, "config", None), "max_seq_len", None)
+    if B is None or S is None:
+        raise ValueError(
+            "lint_engine needs a resolved train_batch_size and a model "
+            "config with max_seq_len to shape the abstract batch"
+        )
+    sharding = engine._batch_sharding(accum_leading=True)
+    shape = (accum, B // accum, S)
+    sds = jax.ShapeDtypeStruct(shape, jnp.int32, sharding=sharding)
+    return {"input_ids": sds, "labels": sds}
+
+
+def _flat_with_paths(tree):
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    paths = [
+        jax.tree_util.keystr(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+    return leaves, paths
+
+
+def trace_train_step(engine):
+    """(closed_jaxpr, arg_shardings, master_pairs, out_shape).
+
+    Traces ``engine._train_step`` (the body of the jitted train step —
+    same program the runtime compiles) with ShapeDtypeStruct state and
+    batch: abstract evaluation, nothing touches devices.
+    """
+    from ..models.sharding import use_topology
+
+    state = engine.state
+    params = jax.tree.map(_as_sds, state.params)
+    opt_state = jax.tree.map(_as_sds, state.opt_state)
+    loss_scale = state.loss_scale
+    step = jax.ShapeDtypeStruct((), jnp.int32)
+    batch = _batch_sds(engine)
+    rng = jax.random.PRNGKey(0)
+
+    def fn(p, o, s, st, b, r):
+        return engine._train_step(p, o, s, st, b, r, None)
+
+    args = (params, opt_state, loss_scale, step, batch, rng)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with use_topology(engine.topology):
+            closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+
+    flat_args, arg_paths = _flat_with_paths(args)
+    invars = list(closed.jaxpr.invars)
+    arg_shardings: Dict[Any, Any] = {}
+    if len(flat_args) == len(invars):
+        for v, leaf in zip(invars, flat_args):
+            s = _leaf_sharding(leaf)
+            if s is not None:
+                arg_shardings[v] = s
+
+    # master pairs: f32 params/opt leaves must round-trip at full precision
+    master_pairs = []
+    out_leaves = jax.tree_util.tree_leaves(out_shape)
+    if len(flat_args) == len(invars) and len(out_leaves) == len(
+        closed.jaxpr.outvars
+    ):
+        n_p = len(jax.tree_util.tree_leaves(params))
+        n_o = len(jax.tree_util.tree_leaves(opt_state))
+        # step outputs: (params, opt, scale, step, metrics) — same leading
+        # structure as the inputs
+        for i in range(n_p + n_o):
+            leaf = flat_args[i]
+            if leaf.dtype == jnp.float32 and out_leaves[i].dtype == jnp.float32:
+                if leaf.shape == out_leaves[i].shape:
+                    master_pairs.append((i, i, arg_paths[i]))
+    return closed, arg_shardings, master_pairs, out_shape
+
+
+def _engine_level_findings(engine, out_shape) -> List[Finding]:
+    """Closure + donation audits at the jit boundary (not jaxpr-visible)."""
+    findings: List[Finding] = []
+    # R2: the chain scans the step — the step's out_shardings must equal
+    # the state's resting shardings leaf-for-leaf
+    state_tuple = engine.state.astuple()
+    for name, tree, shardings in zip(
+        ("params", "opt_state", "loss_scale", "step"),
+        state_tuple,
+        engine._state_shardings,
+    ):
+        in_leaves = jax.tree_util.tree_leaves(tree)
+        out_leaves = jax.tree_util.tree_leaves(shardings)
+        if len(in_leaves) != len(out_leaves):
+            continue
+        for leaf, out_s in zip(in_leaves, out_leaves):
+            fp_in = sharding_fingerprint(_leaf_sharding(leaf))
+            fp_out = sharding_fingerprint(out_s)
+            if fp_in is not None and fp_out is not None and fp_in != fp_out:
+                findings.append(Finding(
+                    rule="R2",
+                    severity=ERROR,
+                    message=(
+                        f"{name}: resting sharding {fp_in} != step "
+                        f"out_sharding {fp_out} — train_batch_chain's scan "
+                        "carry is not closed over the step"
+                    ),
+                    where="<jit boundary>",
+                ))
+    # R4: every donated input buffer should be consumable by some output
+    # (shape/dtype/sharding match); an unusable donation silently doubles
+    # peak memory for that leaf
+    out_avals = {}
+    for leaf in jax.tree_util.tree_leaves(out_shape):
+        key = (tuple(leaf.shape), str(leaf.dtype))
+        out_avals[key] = out_avals.get(key, 0) + 1
+    for name, tree in zip(("params", "opt_state", "loss_scale", "step"),
+                          state_tuple):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            key = (tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
+            if out_avals.get(key, 0) > 0:
+                out_avals[key] -= 1
+            else:
+                findings.append(Finding(
+                    rule="R4",
+                    severity=WARNING,
+                    message=(
+                        f"donated {name} leaf {key[1]}{list(key[0])} has no "
+                        "matching output buffer — the donation is unusable "
+                        "and peak memory holds both copies"
+                    ),
+                    where="<jit boundary>",
+                ))
+    return findings
+
+
+def lint_engine(engine, only: Optional[Sequence[str]] = None,
+                source: Optional[str] = None) -> Report:
+    """Trace + lint one engine's train step. Seconds on CPU."""
+    report = Report()
+    name = source or f"engine[{type(engine).__name__}]"
+    t0 = time.time()
+    closed, arg_shardings, master_pairs, out_shape = trace_train_step(engine)
+    findings = lint_jaxpr(
+        closed,
+        mesh=engine.topology.mesh,
+        arg_shardings=arg_shardings,
+        master_pairs=master_pairs,
+        source=name,
+        only=only,
+    )
+    for f in _engine_level_findings(engine, out_shape):
+        if only is None or f.rule in only:
+            f.source = name
+            findings.append(f)
+    report.extend(findings)
+    report.add_source(name, time.time() - t0, len(findings))
+    return report
+
+
+def lint_config(config, model=None, topology=None,
+                only: Optional[Sequence[str]] = None,
+                source: Optional[str] = None) -> Report:
+    """Build an abstract engine (no state materialization) and lint it.
+
+    ``config`` is anything DeepSpeedConfig accepts (dict / path). The
+    caller owns comm state: an already-initialized topology is reused,
+    else one is built from the config exactly like training would.
+    """
+    import deepspeed_tpu
+
+    if model is None:
+        raise ValueError("lint_config requires a model (the step program "
+                         "is model-shaped); tools/shardlint.py picks one "
+                         "from the config when run as a CLI")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=config, topology=topology, abstract_init=True
+    )
+    try:
+        return lint_engine(engine, only=only, source=source)
+    finally:
+        engine.destroy()

@@ -29,8 +29,6 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-import contextlib
-
 from ..comm.topology import MeshTopology, ParallelDims
 from ..models.decoding import forward_with_cache, init_cache
 from ..models.sharding import use_topology
@@ -71,9 +69,25 @@ def init_inference(
     topology: Optional[MeshTopology] = None,
     params=None,
     rng: Optional[jax.Array] = None,
+    matvec_max_rows: Optional[int] = None,
+    config: Optional[Dict[str, Any]] = None,
     **kwargs,
 ) -> "InferenceEngine":
-    """Parity: deepspeed.init_inference(model, tp_size, dtype, ...)."""
+    """Parity: deepspeed.init_inference(model, tp_size, dtype, ...).
+
+    ``matvec_max_rows`` (also accepted as ``config={"matvec_max_rows": N}``
+    — the "inference.matvec_max_rows" knob) widens the row threshold under
+    which packed int8/int4 projections take the Pallas streaming matvec:
+    e.g. the k=9 speculative verify window is 10 rows and needs ≥ 10.
+    """
+    if config:
+        if matvec_max_rows is None and "matvec_max_rows" in config:
+            matvec_max_rows = int(config["matvec_max_rows"])
+        extras = sorted(set(config) - {"matvec_max_rows"})
+        if extras:
+            log_dist(
+                f"init_inference: ignoring unsupported config keys {extras}"
+            )
     if kwargs:
         log_dist(
             f"init_inference: ignoring unsupported arguments {sorted(kwargs)} "
@@ -113,6 +127,7 @@ def init_inference(
         draft_params=draft_params,
         params=params,
         rng=rng,
+        matvec_max_rows=matvec_max_rows,
     )
 
 
@@ -130,6 +145,7 @@ class InferenceEngine:
         draft_params=None,
         params=None,
         rng: Optional[jax.Array] = None,
+        matvec_max_rows: Optional[int] = None,
     ):
         self.model = model
         self.config = model.config
@@ -156,19 +172,29 @@ class InferenceEngine:
         # the matmul/elementwise chains between them. Scoped via context
         # managers so other engines' kernel choices are untouched.
         on_tpu = topology.mesh.devices.flat[0].platform == "tpu"
+        # inference.matvec_max_rows: per-engine streaming-matvec threshold
+        # (None → kernel default). Applied as a trace-time scope below so
+        # engines with different settings in one process don't fight.
+        self.matvec_max_rows = (
+            int(matvec_max_rows) if matvec_max_rows is not None else None
+        )
 
-        def _injected():
+        def _impl_scopes():
             from contextlib import ExitStack
 
-            from ..ops.attention import attention_impl
-            from ..ops.normalization import pallas_rmsnorm_scope
+            from ..ops.pallas.quantized_matmul import matvec_max_rows_scope
 
             stack = ExitStack()
-            stack.enter_context(attention_impl("auto"))  # flash on TPU
-            stack.enter_context(pallas_rmsnorm_scope(on_tpu))
+            stack.enter_context(matvec_max_rows_scope(self.matvec_max_rows))
+            if kernel_inject:
+                from ..ops.attention import attention_impl
+                from ..ops.normalization import pallas_rmsnorm_scope
+
+                stack.enter_context(attention_impl("auto"))  # flash on TPU
+                stack.enter_context(pallas_rmsnorm_scope(on_tpu))
             return stack
 
-        self._impl_ctx = _injected if kernel_inject else contextlib.nullcontext
+        self._impl_ctx = _impl_scopes
 
         tp_specs = (
             model.partition_specs(topology)
@@ -426,12 +452,14 @@ class InferenceEngine:
                     cand = cand[:, :k]  # the k-th draft is never proposed
                 # --- verify the whole window in one main forward --------
                 # packed weights stream via the Pallas matvec kernel only
-                # while the verify window fits _MATVEC_MAX_ROWS (8): the
+                # while the verify window fits the engine's matvec row
+                # threshold (default 8; inference.matvec_max_rows): the
                 # banked k=9 sweep's 10-row verify takes the
-                # dequantize-then-MXU path instead — same numerics, but
-                # full-width HBM traffic for that forward. Raising the
-                # threshold to ~16 needs an on-chip win at that row count
-                # first (unmeasured).
+                # dequantize-then-MXU path at the default — same numerics,
+                # but full-width HBM traffic for that forward. Set
+                # matvec_max_rows >= k+1 to keep it streaming; making that
+                # the default needs an on-chip win at 10+ rows first
+                # (unmeasured).
                 vlog, main_cache = forward_with_cache(
                     cfg, params, cand,
                     main_cache, pos, dtype=self.dtype

@@ -20,6 +20,7 @@ packs blocks split-half (byte plane g = blocks g and g + G/2) → qdata
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -117,8 +118,35 @@ def _pick_block_n(N: int, D: int) -> int:
 
 # rows at or below this run the streaming kernel; larger shapes (prefill,
 # training would never see PackedWeight) are compute-bound and dequantize
-# once into a regular MXU matmul instead
+# once into a regular MXU matmul instead. Configurable per engine via
+# inference.matvec_max_rows (init_inference) — e.g. the k=9 speculative
+# verify window is 10 rows and needs ≥ 10 to stay on the streaming path.
 _MATVEC_MAX_ROWS = 8
+_matvec_rows_override = None
+
+
+@contextlib.contextmanager
+def matvec_max_rows_scope(rows):
+    """Trace-time override of the streaming-matvec row threshold (None →
+    keep the current value). Scoped like the other kernel selectors so
+    engines with different configs in one process don't fight; must wrap
+    the TRACE of the consuming program (inference engines enter it via
+    their _impl_ctx)."""
+    global _matvec_rows_override
+    prev = _matvec_rows_override
+    if rows is not None:
+        _matvec_rows_override = int(rows)
+    try:
+        yield
+    finally:
+        _matvec_rows_override = prev
+
+
+def matvec_max_rows() -> int:
+    """The active streaming-kernel row threshold."""
+    if _matvec_rows_override is not None:
+        return _matvec_rows_override
+    return _MATVEC_MAX_ROWS
 
 # Measured negative (r5): fusing qkv (and wi+wg) into ONE kernel call by
 # concatenating qdata/scale along columns in-trace LOST on-chip — int8
@@ -148,7 +176,7 @@ def packed_proj(x: jax.Array, w) -> jax.Array:
     lead = x.shape[:-1]
     rows = int(np.prod(lead)) if lead else 1
     if (
-        rows <= _MATVEC_MAX_ROWS
+        rows <= matvec_max_rows()
         and w.qdata.ndim == 3
         and w.scale.shape[-1] % 128 == 0
         and (topo is None or topo.world_size == 1)

@@ -14,6 +14,7 @@ correction for all_gather/reduce_scatter/all_reduce (2x).
 
 from __future__ import annotations
 
+import math
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -109,8 +110,19 @@ class CommsLogger:
         stopped being exposed, over the DMA there was to hide. 0 = fully
         serialized (the xprof_r5_1b_offload baseline), 1 = fully
         overlapped. ``dma_s`` is the estimated one-way+back DMA wall time
-        (stream bytes / host-link bandwidth)."""
-        if dma_s <= 0:
+        (stream bytes / host-link bandwidth).
+
+        Degenerate inputs — an empty/zero-byte offload stream (dma_s 0),
+        unmeasured step times (0 or negative), NaN/inf from a failed A/B
+        leg — report 0.0 (nothing demonstrably overlapped) instead of
+        raising, so a bench summary never dies on its accounting line."""
+        vals = (serial_step_s, overlapped_step_s, dma_s)
+        try:
+            finite = all(math.isfinite(float(v)) for v in vals)
+        except (TypeError, ValueError):
+            return 0.0
+        if not finite or dma_s <= 0 or serial_step_s <= 0 \
+                or overlapped_step_s <= 0:
             return 0.0
         ratio = (serial_step_s - overlapped_step_s) / dma_s
         return max(0.0, min(1.0, ratio))

@@ -185,32 +185,15 @@ class BucketedOptimizer:
 
 
 def bucketed_applicable(params_shape, stacked_key: str = "layers") -> bool:
-    """The scan needs the conventional stacked-layers param layout."""
+    """The scan needs the conventional stacked-layers param layout.
+
+    Dim-0 sharding of the stacked leaves is NOT a disqualifier anymore:
+    the engine re-puts the scanned groups to their resting shardings
+    after the layer scan (``_apply_update``), so the carry-in ==
+    carry-out closure holds for every spec shape — shardlint rule R2
+    (deepspeed_tpu/analysis) checks that invariant statically."""
     return (
         isinstance(params_shape, dict)
         and stacked_key in params_shape
         and len(params_shape) > 1
     )
-
-
-def stacked_dim0_unsharded(*specs_trees) -> bool:
-    """True iff no stacked leaf shards its leading (layer) dim.
-
-    The engine's per-slice placement hooks derive the slice sharding by
-    dropping spec entry 0 (``_bucketed_slice_put``'s ``drop_lead``); if
-    ``add_data_axes`` ever shards dim 0 (L can be the largest dp-divisible
-    dim, e.g. small hidden sizes), the writeback would restore a DIFFERENT
-    sharding than the resting one and break the carry-in == carry-out
-    closure ``train_batch_chain`` scans over. Callers gate bucketed
-    stepping on this predicate instead."""
-    from jax.sharding import PartitionSpec as P
-
-    for tree in specs_trees:
-        leaves = jax.tree_util.tree_leaves(
-            tree, is_leaf=lambda x: isinstance(x, P)
-        )
-        for spec in leaves:
-            entries = tuple(spec)
-            if entries and entries[0] is not None:
-                return False
-    return True

@@ -71,6 +71,7 @@ def initialize(
     mpu=None,
     topology: Optional[MeshTopology] = None,
     rng: Optional[jax.Array] = None,
+    abstract_init: bool = False,
 ):
     """Parity: deepspeed.initialize → (engine, optimizer, dataloader, lr_scheduler).
 
@@ -80,6 +81,12 @@ def initialize(
     (reference: Megatron model-parallel unit) is accepted as an alternate
     spelling of the mesh shape: its get_*_parallel_world_size() methods
     seed ParallelDims when no explicit ``topology`` is given.
+
+    ``abstract_init=True`` builds the engine WITHOUT materializing any
+    state: params/optimizer leaves are ShapeDtypeStructs carrying the
+    exact shardings training would use. Such an engine cannot step — it
+    exists so deepspeed_tpu.analysis (shardlint) can trace and lint the
+    step program of arbitrarily large configs in seconds on CPU.
     """
     if config is None:
         config = config_params
@@ -192,6 +199,7 @@ def initialize(
         optimizer=optimizer,
         model_parameters=model_parameters,
         rng=rng,
+        abstract_init=abstract_init,
     )
 
     dataloader = None
@@ -214,10 +222,13 @@ class TpuEngine:
         optimizer=None,
         model_parameters=None,
         rng: Optional[jax.Array] = None,
+        abstract_init: bool = False,
     ):
         self.model = model
         self.config = config
         self.topology = topology
+        # lint-only shell: state stays ShapeDtypeStructs (see initialize())
+        self.abstract = bool(abstract_init)
         self.timers = SynchronizedWallClockTimer()
         # steady-state samples/sec: async dispatch makes per-call host time
         # track device time once the queue fills; the first steps are skipped
@@ -475,11 +486,7 @@ class TpuEngine:
         # semantics — see runtime/bucketed_opt.py): one layer's m/v/master
         # streams through HBM per scan tick instead of the whole tree's
         # f32 update temps at once (the 1.4B config OOM'd otherwise)
-        from .bucketed_opt import (
-            BucketedOptimizer,
-            bucketed_applicable,
-            stacked_dim0_unsharded,
-        )
+        from .bucketed_opt import BucketedOptimizer, bucketed_applicable
 
         bucketable = (
             off_opt.device == "cpu"
@@ -491,19 +498,11 @@ class TpuEngine:
             and not self.fp16_enabled
             and bucketed_applicable(params_shape)
         )
-        if bucketable and not stacked_dim0_unsharded(
-            self.param_specs["layers"], self.opt_leaf_specs["layers"]
-        ):
-            # the per-slice placement hooks drop spec entry 0; a dp-sharded
-            # layer dim would come back with a different sharding than its
-            # resting one and break the chain's carry-in == carry-out
-            bucketable = False
-            log_dist(
-                "offload_optimizer: per-layer bucketed stepping disabled — "
-                "a stacked leaf shards its leading (layer) dim, which the "
-                "slice placement hooks cannot round-trip; running the "
-                "whole-tree update"
-            )
+        # NOTE: a stacked leaf sharding its leading (layer) dim no longer
+        # disables bucketing (the PR-1 gate): _apply_update re-puts the
+        # scanned groups to their resting shardings after the layer scan,
+        # restoring the carry-in == carry-out closure the slice hooks
+        # alone cannot (shardlint rule R2 checks the invariant statically)
         self._bucketed_opt = (
             BucketedOptimizer(
                 self.optimizer_tx,
@@ -541,7 +540,27 @@ class TpuEngine:
 
         # ---- materialize state (zero.Init parity: params born sharded) -----
         with use_topology(topology):
-            if model_parameters is not None:
+            if self.abstract:
+                # shardlint tracing shell: leaves are ShapeDtypeStructs
+                # carrying the exact shardings the real engine would
+                # materialize — nothing executes on any device
+                if self._compression_cfg is not None:
+                    raise NotImplementedError(
+                        "abstract_init does not support compression_training "
+                        "(mask computation needs real params)"
+                    )
+                if model_parameters is not None:
+                    raise NotImplementedError(
+                        "abstract_init ignores model_parameters; pass none"
+                    )
+                params = jax.tree.map(
+                    lambda a, s: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=s
+                    ),
+                    params_shape,
+                    self.param_shardings,
+                )
+            elif model_parameters is not None:
                 params = jax.device_put(
                     tree_cast(model_parameters, jnp.float32), self.param_shardings
                 )
@@ -610,9 +629,18 @@ class TpuEngine:
                 if self._bucketed_opt is not None
                 else self.optimizer_tx.init
             )
-            opt_state = jax.jit(init_fn, out_shardings=opt_out_shardings)(
-                params
-            )
+            if self.abstract:
+                opt_state = jax.tree.map(
+                    lambda a, s: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=s
+                    ),
+                    jax.eval_shape(init_fn, params),
+                    opt_out_shardings,
+                )
+            else:
+                opt_state = jax.jit(init_fn, out_shardings=opt_out_shardings)(
+                    params
+                )
         self.opt_shardings = jax.tree.map(lambda x: x.sharding, opt_state)
         self._opt_dev_shardings = (
             jax.tree.map(
@@ -627,7 +655,7 @@ class TpuEngine:
             params, opt_state, loss_scale, jnp.zeros((), jnp.int32)
         )
         self.offload_stream = self._compute_offload_stream()
-        if self._nvme_swapper is not None:
+        if self._nvme_swapper is not None and not self.abstract:
             # optimizer state lives on disk between steps (reference:
             # partitioned_optimizer_swapper); swapped in around each update
             self._swap_out_opt()
@@ -1085,14 +1113,35 @@ class TpuEngine:
             # the step must be memory-space-closed (train_batch_chain scans
             # it: carry in == carry out): the rest-group state/params were
             # device_put up top, so return them to their resting placement
+            key = self._bucketed_opt.key
             if self._opt_memory_kind:
                 new_opt = self._put_except(
                     new_opt, self.opt_shardings, "layers"
                 )
             if self._param_memory_kind:
                 new_params = self._put_except(
-                    new_params, self.param_shardings, self._bucketed_opt.key
+                    new_params, self.param_shardings, key
                 )
+            # the stacked groups come back with whatever sharding the layer
+            # scan stacked (the slice hooks drop the leading spec entry, so
+            # a dim-0 partition — L as the largest dp-divisible dim — would
+            # be lost); re-put them to their resting shardings so the carry
+            # closure holds for EVERY spec shape. A no-op re-put compiles
+            # away; this replaced the PR-1 "disable bucketing" gate
+            # (shardlint R2 proves the closure statically).
+            new_params = {
+                **new_params,
+                key: jax.tree.map(
+                    jax.device_put, new_params[key], self.param_shardings[key]
+                ),
+            }
+            new_opt = {
+                **new_opt,
+                "layers": jax.tree.map(
+                    jax.device_put, new_opt["layers"],
+                    self.opt_shardings["layers"],
+                ),
+            }
         new_scale = update_loss_scale(loss_scale, overflow, cfg.fp16, self.fp16_enabled)
         # skipped steps don't advance the schedule (reference scheduler parity)
         new_step = step + jnp.where(overflow, 0, 1).astype(step.dtype)
@@ -1211,6 +1260,14 @@ class TpuEngine:
         self._rng, key = jax.random.split(self._rng)
         return key
 
+    def _check_concrete(self, op: str) -> None:
+        if self.abstract:
+            raise RuntimeError(
+                f"{op}: this engine was built with abstract_init=True — a "
+                "shardlint tracing shell whose state is ShapeDtypeStructs; "
+                "rebuild without abstract_init to run real steps"
+            )
+
     # ---------------------------------------------------------------- API
     def train_batch(self, data_iter=None, batch=None):
         """Parity: PipelineEngine.train_batch / typical engine step loop.
@@ -1218,6 +1275,7 @@ class TpuEngine:
         Accepts either a global-batch dict (``batch=``) or an iterator
         yielding them (``data_iter=``).
         """
+        self._check_concrete("train_batch")
         self.tput.start()
         if batch is None:
             if data_iter is None:
@@ -1422,6 +1480,7 @@ class TpuEngine:
         """
         if steps < 1:
             raise ValueError(f"steps must be >= 1, got {steps}")
+        self._check_concrete("train_batch_chain")
         reasons = self._chain_eligible()
         if reasons or steps == 1:
             if reasons:
@@ -1532,6 +1591,7 @@ class TpuEngine:
         return data_iter
 
     def eval_batch(self, data_iter=None, batch=None):
+        self._check_concrete("eval_batch")
         if batch is None:
             batch = self._next_batch(data_iter)
         if "labels" not in batch:
@@ -1573,6 +1633,7 @@ class TpuEngine:
         forward inside the fused train step at the accumulation boundary, so
         it costs one extra forward per microbatch versus train_batch().
         """
+        self._check_concrete("forward")
         if self.training:
             self._pending_batch = batch
         if "labels" not in batch:
@@ -1736,6 +1797,7 @@ class TpuEngine:
 
     # --------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        self._check_concrete("save_checkpoint")
         from .checkpointing import save_checkpoint as _save
 
         if self._nvme_swapper is not None:

@@ -30,6 +30,17 @@ from ...comm.topology import MeshTopology
 from ...models.transformer import TransformerConfig, apply_layer_stack
 
 
+def neighbor_chain(n_stages: int):
+    """The schedule's p2p fabric: stage i → i+1, NO wraparound edge.
+
+    This is the exact shape shardlint R3 certifies as hang-free (a pure
+    chain: injective, no self-loops, zero cycles —
+    analysis/rules/topology.check_permutation); a ring or a stray extra
+    edge here deadlocks real ICI, which the static check catches before a
+    multi-chip run does."""
+    return [(i, i + 1) for i in range(n_stages - 1)]
+
+
 def pipelined_stack(
     cfg: TransformerConfig,
     layers,
@@ -78,7 +89,7 @@ def pipelined_stack(
         ys, auxs = lax.map(per_mb, (x, positions, seg, jnp.arange(M)))
         return ys, jnp.mean(auxs)
 
-    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+    fwd_perm = neighbor_chain(n_stages)
 
     ticks = M + n_stages - 1
     chunk = 0

@@ -42,6 +42,7 @@ class TensorSwapper:
         self._buffer_count = int(buffer_count)
         self._free: Dict[tuple, list] = {}
         self._last_gen: list = []
+        self._generation = 0
 
     def _take_buf(self, shape, dtype) -> np.ndarray:
         key = (tuple(shape), str(dtype))
@@ -52,13 +53,43 @@ class TensorSwapper:
 
     def _retire_gen(self, bufs: list) -> None:
         """Rotate generations: the previous swap_in's buffers become
-        reusable now that a newer generation has fully landed."""
+        reusable now that a newer generation has fully landed.
+
+        Read-after-overwrite guard (the shardlint R4 hazard class, at the
+        host layer): a buffer may never sit in the free pool while an
+        in-flight disk write still reads from it — the next swap_in would
+        overwrite bytes the aio threadpool is persisting. swap_out buffers
+        are freshly materialized hosts (never pooled), so an overlap here
+        is a wiring bug; refuse loudly rather than corrupt the swap file.
+        """
+        pending_ids = {
+            id(h)
+            for reqs_hosts in self._pending.values()
+            for h in (reqs_hosts[1] or [])
+        }
+        # validate the WHOLE generation before touching the free pool, so
+        # a raise leaves no buffer half-retired (in _free AND _last_gen —
+        # a later successful retire would then double-free it)
+        aliased = [b for b in self._last_gen if id(b) in pending_ids]
+        if aliased:
+            raise RuntimeError(
+                "TensorSwapper: refusing to recycle a read buffer that "
+                "an in-flight write still references (read-after-"
+                "overwrite hazard)"
+            )
         for b in self._last_gen:
             key = (tuple(b.shape), str(b.dtype))
             lst = self._free.setdefault(key, [])
             if len(lst) < self._buffer_count:
                 lst.append(b)
         self._last_gen = bufs
+        self._generation += 1
+
+    @property
+    def generation(self) -> int:
+        """Completed read-buffer generations (observability for tests and
+        the offload stream accounting)."""
+        return self._generation
 
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.leaf{i}.bin")

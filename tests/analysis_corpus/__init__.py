@@ -1,0 +1,7 @@
+"""Seeded-bug corpus for shardlint (ISSUE 2 acceptance gate).
+
+Each fixture in :mod:`fixtures` reintroduces one real hazard class from
+this repo's history as a small traceable program; the shardlint suite
+asserts every one is flagged by its rule — and that the clean twins are
+not.
+"""

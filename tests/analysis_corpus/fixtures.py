@@ -1,0 +1,233 @@
+"""Hazard fixture programs for the shardlint corpus.
+
+Every builder returns ``(closed_jaxpr, lint_kwargs, expect_rule)`` —
+trace-ready evidence of one statically-visible bug class:
+
+- ``stacked_dim0_drift``    R2: the PR-1 bucketed-opt carry drift
+- ``missing_psum_grads``    R1: dp-local grads applied as if reduced
+- ``broken_ppermute_ring``  R3: a pipeline ring with a stray edge
+- ``read_after_donate``     R4: a rotating slot read after overwrite
+- ``truncated_master``      R5: f32 master rebuilt through bf16
+- ``pinned_host_compute``   R5: host-resident bytes fed to compute
+
+Each has a ``*_clean`` twin proving the rules don't fire on the fixed
+form. All fixtures trace on the 8-device CPU mesh (no execution).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.jax_compat import shard_map
+
+
+def corpus_mesh() -> Mesh:
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("dp", "tp"))
+
+
+# --------------------------------------------------------------------- R2
+def _drift_scan(mesh, drift: bool):
+    resting = NamedSharding(mesh, P("dp", None))
+    # the drifted writeback loses the dim-0 partition — exactly what the
+    # bucketed layer scan's drop-lead slice hooks did to a dp-sharded
+    # stacked dim before the PR-2 resting re-put
+    writeback = NamedSharding(mesh, P(None, "tp") if drift else P("dp", None))
+
+    def step(x):
+        x = lax.with_sharding_constraint(x, resting)
+
+        def body(c, _):
+            c = jax.device_put(c * 0.5 + 1.0, writeback)
+            return c, ()
+
+        y, _ = lax.scan(body, x, None, length=4)
+        return y
+
+    sds = jax.ShapeDtypeStruct((8, 2), jnp.float32)
+    return jax.make_jaxpr(step)(sds)
+
+
+def stacked_dim0_drift():
+    mesh = corpus_mesh()
+    return _drift_scan(mesh, True), {"mesh": mesh}, "R2"
+
+
+def stacked_dim0_drift_clean():
+    mesh = corpus_mesh()
+    return _drift_scan(mesh, False), {"mesh": mesh}, "R2"
+
+
+# --------------------------------------------------------------------- R1
+def _grad_step(mesh, reduce_grads: bool):
+    def body(g, p):
+        if reduce_grads:
+            g = lax.pmean(g, "dp")
+        return p - 0.1 * g  # claimed-replicated "updated params"
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("dp"), P()),
+        out_specs=P(),
+        axis_names={"dp", "tp"},
+        check_vma=False,
+    )
+    g = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    p = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+    return jax.make_jaxpr(lambda a, b: fn(a, b))(g, p)
+
+
+def missing_psum_grads():
+    mesh = corpus_mesh()
+    return _grad_step(mesh, False), {"mesh": mesh}, "R1"
+
+
+def missing_psum_grads_clean():
+    mesh = corpus_mesh()
+    return _grad_step(mesh, True), {"mesh": mesh}, "R1"
+
+
+# --------------------------------------------------------------------- R3
+def _pp_ring(mesh, perm):
+    def body(x):
+        return lax.psum(lax.ppermute(x, "dp", perm), "dp")
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P("dp"),
+        out_specs=P(),
+        axis_names={"dp", "tp"},
+        check_vma=False,
+    )
+    x = jax.ShapeDtypeStruct((8, 2), jnp.float32)
+    return jax.make_jaxpr(fn)(x)
+
+
+def broken_ppermute_ring():
+    mesh = corpus_mesh()
+    # ring 1→2→3→1 plus a stray 0→1 edge: duplicate destination — the
+    # schedule hangs members on real ICI
+    perm = [(1, 2), (2, 3), (3, 1), (0, 1)]
+    return _pp_ring(mesh, perm), {"mesh": mesh}, "R3"
+
+
+def broken_ppermute_ring_clean():
+    mesh = corpus_mesh()
+    perm = [(i, (i + 1) % 4) for i in range(4)]  # full single ring
+    return _pp_ring(mesh, perm), {"mesh": mesh}, "R3"
+
+
+# --------------------------------------------------------------------- R4
+def _rotating_slot(stale_read: bool):
+    def prog(slots, xs):
+        def body(carry, x):
+            buf = carry
+            new = lax.dynamic_update_slice(buf, x[None], (0, 0))
+            if stale_read:
+                # reads the PRE-overwrite generation: the rotating slot
+                # already holds the new bytes
+                out = buf[0] + x
+            else:
+                out = new[0] + x
+            return new, out
+
+        return lax.scan(body, slots, xs)
+
+    slots = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+    xs = jax.ShapeDtypeStruct((3, 4), jnp.float32)
+    return jax.make_jaxpr(prog)(slots, xs)
+
+
+def read_after_donate():
+    return _rotating_slot(True), {}, "R4"
+
+
+def read_after_donate_clean():
+    return _rotating_slot(False), {}, "R4"
+
+
+# --------------------------------------------------------------------- R5
+def _master_update(truncate: bool):
+    def prog(p, g):
+        u = g.astype(jnp.float32) * -0.1
+        if truncate:
+            p = p.astype(jnp.bfloat16).astype(jnp.float32)
+        return p + u
+
+    p = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+    g = jax.ShapeDtypeStruct((4, 4), jnp.bfloat16)
+    closed = jax.make_jaxpr(prog)(p, g)
+    return closed, {"master_pairs": [(0, 0, "params")]}
+
+
+def truncated_master():
+    closed, kw = _master_update(True)
+    return closed, kw, "R5"
+
+
+def truncated_master_clean():
+    closed, kw = _master_update(False)
+    return closed, kw, "R5"
+
+
+class _FakePinnedSharding:
+    """Duck-typed pinned-host sharding: CPU devices expose no pinned_host
+    memory space, so the corpus seeds the placement evidence directly —
+    rules only read ``.spec`` / ``.memory_kind``."""
+
+    memory_kind = "pinned_host"
+    spec = P()
+
+
+def _pinned_host(copy_first: bool):
+    mesh = corpus_mesh()
+
+    def prog(m):
+        if copy_first:
+            m = jax.device_put(m, NamedSharding(mesh, P()))
+        return m * 2.0 + 1.0
+
+    m = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+    closed = jax.make_jaxpr(prog)(m)
+    # both twins start from a pinned-host master; the clean one copies to
+    # device memory before any math touches it
+    kw = {
+        "mesh": mesh,
+        "arg_shardings": {closed.jaxpr.invars[0]: _FakePinnedSharding()},
+    }
+    return closed, kw
+
+
+def pinned_host_compute():
+    closed, kw = _pinned_host(False)
+    return closed, kw, "R5"
+
+
+def pinned_host_compute_clean():
+    closed, kw = _pinned_host(True)
+    return closed, kw, "R5"
+
+
+HAZARDS = [
+    stacked_dim0_drift,
+    missing_psum_grads,
+    broken_ppermute_ring,
+    read_after_donate,
+    truncated_master,
+    pinned_host_compute,
+]
+
+CLEAN_TWINS = [
+    stacked_dim0_drift_clean,
+    missing_psum_grads_clean,
+    broken_ppermute_ring_clean,
+    read_after_donate_clean,
+    truncated_master_clean,
+    pinned_host_compute_clean,
+]

@@ -301,3 +301,36 @@ def test_autotuner_phase3_bwd_tiles(monkeypatch):
     patch = result_to_config_patch(best)
     tk = patch["tpu_kernels"]
     assert tk["flash_block_q_bwd"] == 512 and tk["flash_block_k_bwd"] == 256
+
+
+def test_tensor_swapper_generation_pool_rotation(tmp_path):
+    """The two-generation read-buffer pool (shardlint R4's host-layer
+    twin): generation N's buffers are recycled only after generation N+1
+    fully lands, and a buffer still referenced by an in-flight write is
+    never handed back to the free pool."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
+
+    sw = TensorSwapper(str(tmp_path), num_threads=1, reuse_buffers=True,
+                       buffer_count=2)
+    tree = {"m": jnp.arange(16, dtype=jnp.float32)}
+    shardings = {"m": SingleDeviceSharding(jax.devices()[0])}
+    sw.swap_out("opt", tree)
+    assert sw.generation == 0
+    t1 = sw.swap_in("opt", shardings=shardings)
+    assert sw.generation == 1  # gen rotated; previous gen (empty) retired
+    t2 = sw.swap_in("opt", shardings=shardings)
+    assert sw.generation == 2
+    np.testing.assert_array_equal(np.asarray(t1["m"]), np.asarray(t2["m"]))
+    # un-pooled path (no shardings → raw aliasing return) never rotates
+    sw.swap_in("opt")
+    assert sw.generation == 2
+    # planting a pending-write alias of a last-gen buffer must refuse the
+    # recycle instead of corrupting the swap file
+    sw._pending["bogus"] = ([], list(sw._last_gen))
+    with pytest.raises(RuntimeError, match="read-after-overwrite"):
+        sw._retire_gen([])
+    sw._pending.pop("bogus")
+    sw.close()

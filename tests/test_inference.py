@@ -778,3 +778,77 @@ def test_moe_quantized_serving_runs():
     out = eng.generate(prompt, max_new_tokens=6, temperature=0.0)
     assert out.shape == (1, 12)
     assert (np.asarray(out) < 128).all()
+
+
+def test_matvec_max_rows_scope_switches_kernel_path(monkeypatch):
+    """inference.matvec_max_rows (ADVICE r5 #2 follow-up): a 10-row
+    projection — the k=9 speculative verify window — takes the dequantize
+    path at the default threshold (8) and the Pallas streaming matvec
+    once the threshold covers it."""
+    from deepspeed_tpu.ops.pallas import quantized_matmul as qm
+    from deepspeed_tpu.ops.quantizer import pack_quantize_blockwise
+
+    w = np.random.RandomState(0).randn(128, 128).astype(np.float32)
+    packed = pack_quantize_blockwise(jnp.asarray(w), block=128, bits=8)
+    x = jnp.asarray(np.random.RandomState(1).randn(10, 128), jnp.float32)
+
+    calls = []
+    real = qm._packed_matvec
+
+    def spy(x2d, qdata, scale, **kw):
+        calls.append(x2d.shape)
+        return real(x2d, qdata, scale, **kw)
+
+    monkeypatch.setattr(qm, "_packed_matvec", spy)
+
+    y_deq = qm.packed_proj(x, packed)  # default threshold 8 < 10 rows
+    assert calls == []
+    with qm.matvec_max_rows_scope(16):
+        assert qm.matvec_max_rows() == 16
+        y_stream = qm.packed_proj(x, packed)
+    assert calls == [(10, 128)]
+    assert qm.matvec_max_rows() == qm._MATVEC_MAX_ROWS  # scope restored
+    # same numerics either path (fp32 kernel pins HIGHEST dot precision)
+    np.testing.assert_allclose(np.asarray(y_stream), np.asarray(y_deq),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_speculative_verify_window_streams_with_configured_threshold(
+    monkeypatch,
+):
+    """CPU-path end-to-end: with inference.matvec_max_rows=16 the k=9
+    speculative verify forward (10 rows) engages the streaming kernel at
+    trace time; at the default threshold it never does. Tokens match the
+    unconfigured engine either way."""
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.pallas import quantized_matmul as qm
+
+    model = tiny_llama(hidden_size=128, intermediate_size=256)
+    prompt = np.random.RandomState(3).randint(
+        0, model.config.vocab_size, size=(1, 8))
+
+    rows_seen = []
+    real = qm._packed_matvec
+
+    def spy(x2d, qdata, scale, **kw):
+        rows_seen.append(x2d.shape[0])
+        return real(x2d, qdata, scale, **kw)
+
+    monkeypatch.setattr(qm, "_packed_matvec", spy)
+
+    def run(**engine_kw):
+        rows_seen.clear()
+        eng = deepspeed_tpu.init_inference(
+            model, dtype=jnp.float32, quantize_bits=8, max_tokens=64,
+            draft_model="ngram", rng=jax.random.PRNGKey(0), **engine_kw,
+        )
+        out = eng.generate(prompt, max_new_tokens=12, num_draft_tokens=9)
+        return eng, np.asarray(out), list(rows_seen)
+
+    base_eng, base_out, base_rows = run()
+    assert base_eng.matvec_max_rows is None
+    assert 10 not in base_rows  # default threshold 8: verify dequantizes
+    cfg_eng, cfg_out, cfg_rows = run(config={"matvec_max_rows": 16})
+    assert cfg_eng.matvec_max_rows == 16  # the "inference." config spelling
+    assert 10 in cfg_rows  # the verify window streams now
+    np.testing.assert_array_equal(base_out, cfg_out)

@@ -225,3 +225,27 @@ def test_native_tfevents_writer_roundtrip(tmp_path):
             )
     assert abs(scalars["Train/loss"] - 2.5) < 1e-6
     assert scalars["Train/lr"] > 0
+
+
+def test_offload_overlap_ratio_degenerate_inputs():
+    """ISSUE 2 satellite: zero-duration / empty offload streams and failed
+    A/B legs must report 0.0 overlap, never raise."""
+    r = CommsLogger.offload_overlap_ratio
+    assert r(0.0, 0.0, 0.0) == 0.0          # empty stream, nothing timed
+    assert r(4.0, 3.0, 0.0) == 0.0          # zero-byte stream → no DMA
+    assert r(0.0, 3.0, 2.0) == 0.0          # unmeasured serial leg
+    assert r(4.0, 0.0, 2.0) == 0.0          # unmeasured overlapped leg
+    assert r(-1.0, 3.0, 2.0) == 0.0         # negative wall time
+    assert r(float("nan"), 3.0, 2.0) == 0.0  # failed A/B leg
+    assert r(float("inf"), 3.0, 2.0) == 0.0
+    assert r(None, 3.0, 2.0) == 0.0          # type junk survives too
+    # the happy path is untouched by the guards
+    assert r(4.0, 3.0, 2.0) == 0.5
+    # empty-stream summary stays empty (no division by zero steps)
+    logger = CommsLogger()
+    try:
+        assert logger.offload_summary(duration_s=0.0) == ""
+        logger.record_offload(0, 0, slots=0, slot_bytes=0, steps=1)
+        assert "0.00 GiB/step" in logger.offload_summary(duration_s=0.0)
+    finally:
+        logger.stop()
