@@ -97,9 +97,51 @@ def all_to_all(x, axis_name: AxisName, split_axis: int, concat_axis: int, tiled:
     return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
 
 
-def permute(x, axis_name: AxisName, perm):
+def check_permutation(perm, axis_size: int):
+    """Problems with a ppermute permutation (empty list == well-formed).
+
+    Re-exported from analysis/rules/topology.py — ONE implementation is
+    both the static lint (shardlint R3) and the construction-time guard
+    below, so "passes the hook" and "passes the lint" can never drift."""
+    from ..analysis.rules.topology import check_permutation as _check
+
+    return _check(perm, axis_size)
+
+
+def permute(x, axis_name: AxisName, perm, *, validate: bool = True):
     """Parity: deepspeed.comm send/recv pairs in the pipeline engine — a
-    static ring/permutation shift via collective-permute over ICI."""
+    static ring/permutation shift via collective-permute over ICI.
+
+    Ring/chain contract (the same one shardlint R3 certifies and
+    runtime/pipe/schedule.neighbor_chain states): ``perm`` must be an
+    injective partial map with no self-loops whose cycle structure is
+    either pure chains (the pipeline neighbor hop) or ONE full ring
+    covering the whole axis — anything else (disjoint sub-rings, a ring
+    plus stray edges, duplicate endpoints) is not a wrong answer on real
+    ICI but a *hang*. With ``validate=True`` (default) the contract is
+    enforced at construction time via
+    :func:`analysis.rules.topology.check_permutation`, so callers like
+    parallel/tensor_overlap's decomposed-matmul rings are lint-guaranteed
+    the moment they trace, not only when shardlint later walks the jaxpr.
+    Validation needs the static axis size; where it cannot be determined
+    (outside any mapped context) the check is skipped and shardlint
+    remains the backstop."""
+    if validate:
+        n = None
+        try:
+            from ..utils.jax_compat import axis_size
+
+            n = int(axis_size(axis_name))
+        except Exception:  # noqa: BLE001 — unbound/odd axis env: lint-only
+            n = None
+        if n is not None:
+            problems = check_permutation(perm, n)
+            if problems:
+                raise ValueError(
+                    f"malformed ppermute permutation over axis "
+                    f"{axis_name!r} (size {n}): " + "; ".join(problems)
+                    + " — this hangs or deadlocks on real ICI"
+                )
     _record("ppermute", axis_name, x)
     return lax.ppermute(x, axis_name, perm=perm)
 

@@ -200,10 +200,45 @@ class MoEConfig:
 
 
 @dataclass
+class OverlapCommConfig:
+    """"tensor_parallel.overlap_comm" — decomposed (ring) collective
+    matmul at the TP projection boundaries (parallel/tensor_overlap.py):
+    the Megatron all-gather/reduce-scatter pair decomposes into ppermute
+    rings whose hops hide under the per-chunk matmuls (T3, arXiv
+    2401.16677). Default OFF until an on-chip A/B lands (the same
+    protocol as zero_optimization.offload_double_buffer); numerics of the
+    unquantized rings are oracle-verified bitwise against the XLA
+    reference path on a CPU mesh (tests/test_tp_overlap.py)."""
+
+    enabled: bool = False
+    # matmul sub-chunks per ring step (scheduling granularity for the
+    # DMA/MXU overlap; never changes numerics — uneven splits allowed)
+    chunks: int = 1
+    # send half the payload around each ring direction simultaneously:
+    # full-duplex ICI halves per-hop wire time at the same hop count
+    bidirectional: bool = False
+    # int8 + fp32 lane-scale hop wire (ZeRO++ qwZ composition). Gather
+    # wires quantize once at the source; scatter accumulators re-quantize
+    # per hop (error O(tp) — see docs/collective_matmul.md). Forward-only:
+    # in training the backward runs the unquantized transpose
+    # (straight-through — int8 casts would otherwise zero the activation
+    # cotangents), mirroring ZeRO++'s qwZ/qgZ split.
+    quantized_hops: bool = False
+
+    def validate(self) -> None:
+        if int(self.chunks) < 1:
+            raise DeepSpeedConfigError(
+                f"tensor_parallel.overlap_comm.chunks must be >= 1, got "
+                f"{self.chunks}"
+            )
+
+
+@dataclass
 class TensorParallelConfig:
     """Parity: autotp / "tensor_parallel" section."""
 
     tp_size: int = 1
+    overlap_comm: OverlapCommConfig = field(default_factory=OverlapCommConfig)
 
 
 @dataclass
@@ -456,9 +491,15 @@ class DeepSpeedConfig:
             pipe["stages"] = pipe.pop("num_stages")
         self.pipeline = _parse_dc(PipelineConfig, pipe)
         self.moe = _parse_dc(MoEConfig, d.get("moe"))
-        tp = d.get("tensor_parallel") or {}
+        tp = dict(d.get("tensor_parallel") or {})
         if "autotp_size" in tp and "tp_size" not in tp:
-            tp = {"tp_size": tp["autotp_size"]}
+            # alias only — the rest of the section (overlap_comm) survives
+            tp["tp_size"] = tp.pop("autotp_size")
+        oc = tp.get("overlap_comm")
+        if isinstance(oc, bool):
+            # the spelling zero_optimization.overlap_comm users expect
+            oc = {"enabled": oc}
+        tp["overlap_comm"] = _parse_dc(OverlapCommConfig, oc)
         self.tensor_parallel = _parse_dc(TensorParallelConfig, tp)
         sp = d.get("sequence_parallel") or {}
         if "sequence_parallel_size" in d:
@@ -560,6 +601,17 @@ class DeepSpeedConfig:
                 "progressive_layer_drop is not supported with pipeline "
                 "parallelism (the stochastic layer gate would have to cross "
                 "pp stage boundaries)"
+            )
+        self.tensor_parallel.overlap_comm.validate()
+        if (
+            self.tensor_parallel.overlap_comm.enabled
+            and self.pipeline.stages > 1
+        ):
+            raise DeepSpeedConfigError(
+                "tensor_parallel.overlap_comm is not supported with pipeline "
+                "parallelism (the decomposed matmul is a full-manual "
+                "shard_map and cannot nest inside the pipeline's manual "
+                "schedule); the runtime also falls back per call site"
             )
         if self.data_efficiency.random_ltd.enabled and self.pipeline.stages > 1:
             raise DeepSpeedConfigError(

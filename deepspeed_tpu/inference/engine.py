@@ -93,8 +93,20 @@ def init_inference(
             f"init_inference: ignoring unsupported arguments {sorted(kwargs)} "
             f"(reference-surface kwargs with no TPU equivalent)"
         )
+    overlap_comm = None
     if tensor_parallel:
         tp_size = tensor_parallel.get("tp_size", tp_size)
+        if tensor_parallel.get("overlap_comm"):
+            # same section schema as the training config's
+            # tensor_parallel.overlap_comm (decomposed collective matmul);
+            # a bare boolean means {"enabled": bool}
+            from ..config import OverlapCommConfig, _parse_dc
+
+            oc = tensor_parallel["overlap_comm"]
+            if isinstance(oc, bool):
+                oc = {"enabled": oc}
+            overlap_comm = _parse_dc(OverlapCommConfig, oc)
+            overlap_comm.validate()
     if checkpoint is not None:
         if params is not None:
             raise ValueError("pass either checkpoint= or params=, not both")
@@ -128,6 +140,7 @@ def init_inference(
         params=params,
         rng=rng,
         matvec_max_rows=matvec_max_rows,
+        overlap_comm=overlap_comm,
     )
 
 
@@ -146,6 +159,7 @@ class InferenceEngine:
         params=None,
         rng: Optional[jax.Array] = None,
         matvec_max_rows: Optional[int] = None,
+        overlap_comm=None,
     ):
         self.model = model
         self.config = model.config
@@ -178,14 +192,49 @@ class InferenceEngine:
         self.matvec_max_rows = (
             int(matvec_max_rows) if matvec_max_rows is not None else None
         )
+        # decomposed TP collective matmul for the serving projections
+        # (tensor_parallel.overlap_comm — parallel/tensor_overlap.py): the
+        # decode out-projections take the feature-scatter ring (S=1 cannot
+        # seq-shard), prefill takes the Megatron-SP pair when shapes divide
+        self.tp_overlap = (
+            overlap_comm
+            if (
+                overlap_comm is not None
+                and getattr(overlap_comm, "enabled", False)
+                and topology.tp_size > 1
+            )
+            else None
+        )
+        if self.tp_overlap is not None:
+            from ..parallel.tensor_overlap import static_widths_divide
+
+            reason = None
+            if quantize_bits:
+                # every big projection is a PackedWeight — the ring
+                # dispatchers always fall back for packed leaves, so the
+                # scope would only buy residual-layout churn
+                reason = f"packed int{quantize_bits} weights take the " \
+                         "streaming-matvec path, not the rings"
+            elif not static_widths_divide(self.config, topology.tp_size):
+                reason = (
+                    "a projection width does not divide "
+                    f"tp={topology.tp_size}"
+                )
+            if reason:
+                log_dist(
+                    f"tensor_parallel.overlap_comm disabled: {reason}"
+                )
+                self.tp_overlap = None
 
         def _impl_scopes():
             from contextlib import ExitStack
 
             from ..ops.pallas.quantized_matmul import matvec_max_rows_scope
+            from ..parallel.tensor_overlap import overlap_scope
 
             stack = ExitStack()
             stack.enter_context(matvec_max_rows_scope(self.matvec_max_rows))
+            stack.enter_context(overlap_scope(self.tp_overlap))
             if kernel_inject:
                 from ..ops.attention import attention_impl
                 from ..ops.normalization import pallas_rmsnorm_scope
@@ -220,6 +269,7 @@ class InferenceEngine:
                     return PackedWeight(
                         NamedSharding(mesh, qs), NamedSharding(mesh, ss),
                         leaf.shape, leaf.bits, leaf.dtype, leaf.nibbles,
+                        leaf.pspec,
                     )
                 return NamedSharding(mesh, spec)
 
@@ -285,11 +335,13 @@ class InferenceEngine:
         shards along
         the weight's own partition spec (packed_partition_specs: blocks
         stay whole — the contraction dim is stored (G, B) and only G
-        shards), so per-shard HBM *residency* stays quantized — but
-        packed_proj falls back to dequantize-then-dot whenever
-        world_size > 1 (a bare pallas_call has no GSPMD partitioning
-        rule), so each TP decode step re-materializes full-width weights
-        until the kernel grows a shard_map wrapper. A
+        shards), and the leaf remembers that spec (PackedWeight.pspec) so
+        packed_proj's full-manual shard_map wrapper can run the streaming
+        kernel PER SHARD — under tp>1 the decode matvec streams quantized
+        bytes instead of dequantizing full-width weights every step (a
+        bare pallas_call has no GSPMD partitioning rule, which is why the
+        wrapper exists; leaves without a usable pspec still fall back to
+        dequantize-then-dot). A
         leaf whose block/nibble geometry does not divide over the mesh
         falls back to the fake-quant roundtrip (numerics identical either
         way — same q/dq values), logged by name."""
@@ -315,7 +367,10 @@ class InferenceEngine:
                     f"spec {spec})"
                 )
                 return quantize_dequantize(leaf, block=128, bits=bits)
-            return pack_quantize_blockwise(leaf, block=128, bits=bits)
+            pw = pack_quantize_blockwise(leaf, block=128, bits=bits)
+            if sharded:
+                pw.pspec = spec  # trace-time spec for the shard_map wrapper
+            return pw
 
         if sharded:
             return jax.tree_util.tree_map_with_path(q, params, tp_specs)

@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pallas.quantized_matmul import packed_proj
 from .sharding import constrain
 from .transformer import (
     Params,
@@ -70,12 +69,29 @@ def _quantize_kv(t: jax.Array):
     return q, jnp.broadcast_to(s, (*s.shape[:-1], SCALE_LANES))
 
 
+def _out_proj(x: jax.Array, w) -> jax.Array:
+    """Row-parallel attention out-projection. Under the
+    tensor_parallel.overlap_comm scope this is a decomposed ring
+    (parallel/tensor_overlap.tp_out_proj): prefill takes the
+    sequence-scatter form, the S=1 decode step the feature-scatter +
+    gather form whose reduce-scatter half hides under the matmul; packed
+    weights and non-dividing shapes fall back to the plain projection."""
+    from ..parallel.tensor_overlap import tp_out_proj
+
+    return tp_out_proj(x, w)
+
+
 def _qkv(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array):
+    from ..parallel.tensor_overlap import tp_in_proj
+
     B, S, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
-    q = packed_proj(x, p["wq"]).reshape(B, S, nh, hd)
-    k = packed_proj(x, p["wk"]).reshape(B, S, nkv, hd)
-    v = packed_proj(x, p["wv"]).reshape(B, S, nkv, hd)
+    # one shared gather ring under overlap_comm when the prefill sequence
+    # divides the tp ring; decode (S=1) and packed weights fall back
+    qp, kp, vp = tp_in_proj(x, (p["wq"], p["wk"], p["wv"]))
+    q = qp.reshape(B, S, nh, hd)
+    k = kp.reshape(B, S, nkv, hd)
+    v = vp.reshape(B, S, nkv, hd)
     if cfg.use_bias:
         q = q + p["bq"].reshape(1, 1, nh, hd)
         k = k + p["bk"].reshape(1, 1, nkv, hd)
@@ -144,7 +160,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
         )
         out = attn_op(q, k, v, causal=True, alibi_slopes=slopes)
         out = out.reshape(B, S, nh * hd)
-        out = packed_proj(out, p["wo"])
+        out = _out_proj(out, p["wo"])
         if cfg.use_bias:
             out = out + p["bo"]
         return ret(out)
@@ -162,7 +178,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
             )
             if out is not None:
                 out = out.astype(x.dtype).reshape(B, S, nh * hd)
-                out = packed_proj(out, p["wo"])
+                out = _out_proj(out, p["wo"])
                 if cfg.use_bias:
                     out = out + p["bo"]
                 return ret(out)
@@ -191,7 +207,7 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
     out = out.reshape(B, S, nh * hd)
-    out = packed_proj(out, p["wo"])
+    out = _out_proj(out, p["wo"])
     if cfg.use_bias:
         out = out + p["bo"]
     return ret(out)

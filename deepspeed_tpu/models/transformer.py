@@ -30,7 +30,6 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .sharding import constrain, current_topology
-from ..ops.pallas.quantized_matmul import packed_proj
 
 Params = Dict[str, Any]
 
@@ -233,12 +232,16 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
                segment_ids: Optional[jax.Array],
                pos_default: bool = True) -> jax.Array:
     from ..ops.attention import attention as attn_op
+    from ..parallel.tensor_overlap import tp_in_proj, tp_out_proj
 
     B, S, d = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
-    q = packed_proj(x, p["wq"]).reshape(B, S, nh, hd)
-    k = packed_proj(x, p["wk"]).reshape(B, S, nkv, hd)
-    v = packed_proj(x, p["wv"]).reshape(B, S, nkv, hd)
+    # qkv share ONE decomposed gather ring when overlap_comm is active
+    # (plain einsums otherwise — tp_in_proj falls back per weight)
+    qp, kp, vp = tp_in_proj(x, (p["wq"], p["wk"], p["wv"]))
+    q = qp.reshape(B, S, nh, hd)
+    k = kp.reshape(B, S, nkv, hd)
+    v = vp.reshape(B, S, nkv, hd)
     if cfg.use_bias:
         q = q + p["bq"].reshape(1, 1, nh, hd)
         k = k + p["bk"].reshape(1, 1, nkv, hd)
@@ -280,7 +283,7 @@ def _attention(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.A
             alibi_slopes=slopes,
         )  # [B,S,H,hd]
     out = out.reshape(B, S, nh * hd)
-    out = packed_proj(out, p["wo"])
+    out = tp_out_proj(out, p["wo"])  # scatter ring under overlap_comm
     if cfg.use_bias:
         out = out + p["bo"]
     return out
@@ -299,16 +302,19 @@ def _mlp(cfg: TransformerConfig, p: Params, x: jax.Array, rng: Optional[jax.Arra
         from ..moe.sharded_moe import moe_layer
 
         return moe_layer(cfg, p, x, rng, train)
-    h = packed_proj(x, p["wi"])
+    from ..parallel.tensor_overlap import tp_in_proj, tp_out_proj
+
     if cfg.activation == "swiglu":
-        g = packed_proj(x, p["wg"])
+        # wi and the gate share one decomposed gather ring under overlap
+        h, g = tp_in_proj(x, (p["wi"], p["wg"]))
         h = jax.nn.silu(g) * h
     else:
+        (h,) = tp_in_proj(x, (p["wi"],))
         if cfg.use_bias:
             h = h + p["bi"]
         h = _act(cfg, h)
     h = constrain(h, ("dp", "fsdp"), "sp", "tp")
-    out = packed_proj(h, p["wo"])
+    out = tp_out_proj(h, p["wo"])
     if cfg.use_bias and not cfg.activation == "swiglu":
         out = out + p["bo"]
     return out, jnp.zeros((), jnp.float32)
@@ -319,15 +325,22 @@ def _block(cfg: TransformerConfig, layer: Params, x: jax.Array, positions: jax.A
            pos_default: bool = True):
     from jax.ad_checkpoint import checkpoint_name
 
+    from ..parallel.tensor_overlap import seq_shard_axes
+
+    # under overlap_comm the residual stream stays sequence-sharded over
+    # (sp, tp) — the scatter rings produce that layout and the gather
+    # rings consume it, so the residual adds (and the norms) cost zero
+    # collectives between projections (Megatron-SP boundaries)
+    seq_ax = seq_shard_axes(x)
     h = _attention(cfg, layer["attn"], _norm(cfg, layer["ln1"], x), positions,
                    segment_ids, pos_default)
     h = checkpoint_name(h, "attn_out")  # selective remat anchor (attn_only)
     x = x + h
-    x = constrain(x, ("dp", "fsdp"), "sp", None)
+    x = constrain(x, ("dp", "fsdp"), seq_ax, None)
     m, aux = _mlp(cfg, layer["mlp"], _norm(cfg, layer["ln2"], x), rng, train)
     m = checkpoint_name(m, "mlp_out")
     x = x + m
-    x = constrain(x, ("dp", "fsdp"), "sp", None)
+    x = constrain(x, ("dp", "fsdp"), seq_ax, None)
     return x, aux
 
 
@@ -467,7 +480,13 @@ def embed_tokens(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
     if cfg.embed_norm:
         x = _norm(cfg, cast(params["embed_norm"]), x)
     lead = (None,) * (input_ids.ndim - 2)
-    return constrain(x, *lead, ("dp", "fsdp"), "sp", None)
+    # match the block boundary layout (seq over (sp, tp) under
+    # overlap_comm) so the layer-scan carry is sharding-closed — a
+    # mismatch would re-shard the residual stream every scanned layer
+    # (shardlint R2 flags exactly that)
+    from ..parallel.tensor_overlap import seq_shard_axes
+
+    return constrain(x, *lead, ("dp", "fsdp"), seq_shard_axes(x), None)
 
 
 def lm_head_weight(cfg: TransformerConfig, params: Params) -> jax.Array:

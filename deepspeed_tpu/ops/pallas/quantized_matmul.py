@@ -155,18 +155,139 @@ def matvec_max_rows() -> int:
 # while-loop (or the wider single grid schedules worse), so per-weight
 # launches stay.
 
+# trace-time path observability: tests assert the tp>1 decode matvec
+# actually STREAMS (takes a kernel path) instead of only checking packed
+# HBM residency — counts bump when a path is traced, not per step
+_STREAM_TRACES = {"single": 0, "sharded": 0}
+
+
+def streaming_trace_counts() -> dict:
+    return dict(_STREAM_TRACES)
+
+
+def reset_streaming_trace_counts() -> None:
+    _STREAM_TRACES["single"] = 0
+    _STREAM_TRACES["sharded"] = 0
+
+
+def _spec_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+
+def _axes_extent(mesh, axes: tuple) -> int:
+    n = 1
+    for a in axes:
+        n *= int(mesh.shape[a])
+    return n
+
+
+def _matvec_pspec_entries(w):
+    """(row_entry, col_entry) of the weight's matmul dims, or None.
+
+    The pspec is the ORIGINAL (possibly stacked [L, d, n]) weight's spec;
+    a lax.scan over the stacked leaf hands packed_proj a per-layer slice
+    whose aux still carries the full spec — so only the trailing two
+    entries describe the live (d, n) dims, and any sharded leading
+    (layer) entry disqualifies the per-slice wrapper."""
+    if w.pspec is None:
+        return None
+    ndim = max(len(w.shape), 2)
+    entries = tuple(w.pspec) + (None,) * (ndim - len(tuple(w.pspec)))
+    if any(e is not None for e in entries[:-2]):
+        return None
+    return entries[-2], entries[-1]
+
+
+def _sharded_matvec_ok(w, topo, x_cols: int) -> bool:
+    """Whether the per-shard streaming kernel applies to this packed leaf
+    on this mesh: a remembered spec whose shards keep whole 128-lane
+    tiles and whole quantization blocks (int4 nibble pairs cannot split
+    across row shards — quantizer split-half packing)."""
+    rc = _matvec_pspec_entries(w)
+    if rc is None or w.qdata.ndim != 3:
+        return False
+    row_axes, col_axes = _spec_axes(rc[0]), _spec_axes(rc[1])
+    mesh = topo.mesh
+    try:
+        re_, ce = _axes_extent(mesh, row_axes), _axes_extent(mesh, col_axes)
+    except KeyError:
+        return False
+    if re_ == 1 and ce == 1:
+        return False  # replicated: the single-device kernel path applies
+    G, N = w.scale.shape[0], w.scale.shape[-1]
+    return (
+        N % ce == 0
+        and (N // ce) % 128 == 0
+        and G % re_ == 0
+        and x_cols % re_ == 0
+        and w.qdata.shape[0] % re_ == 0
+        and not (w.nibbles and re_ > 1)
+    )
+
+
+def _packed_matvec_sharded(x2d, w, topo):
+    """Run the streaming matvec PER SHARD under tp>1 serving.
+
+    A bare pallas_call has no GSPMD partitioning rule, so without this
+    wrapper the sharded qdata/scale operands dequantize full-width in
+    XLA every decode step (measured 3x slower at 410M). Full-manual
+    shard_map over the whole mesh (runs on legacy jax 0.4.x): column
+    shards emit their output slice with no collective; row (contraction)
+    shards psum their partials — the same collective GSPMD would insert,
+    but the HBM stream per shard is the int8/int4 bytes."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...utils.jax_compat import shard_map
+
+    row_e, col_e = _matvec_pspec_entries(w)
+    row_axes = _spec_axes(row_e)
+    mesh = topo.mesh
+    re_, ce = _axes_extent(mesh, row_axes), _axes_extent(
+        mesh, _spec_axes(col_e)
+    )
+    N_loc = w.scale.shape[-1] // ce
+    D_loc = x2d.shape[1] // re_
+    qspec = P(row_e, None, col_e)
+    sspec = P(row_e, None, col_e)
+
+    def body(xl, qd, sc):
+        y = _packed_matvec(
+            xl, qd, sc,
+            block_n=_pick_block_n(N_loc, D_loc),
+            nibbles=w.nibbles,
+        )
+        if row_axes:
+            # contraction-sharded (row-parallel): reduce the partials in
+            # fp32 — XLA's CPU AllReducePromotion pass crashes on bf16
+            # all-reduce under shard_map (same workaround as the pipeline)
+            y = jax.lax.psum(y.astype(jnp.float32), row_axes).astype(y.dtype)
+        return y
+
+    run = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, row_e), qspec, sspec),
+        out_specs=P(None, col_e),
+        axis_names=set(mesh.axis_names),
+        check_vma=False,
+    )
+    _STREAM_TRACES["sharded"] += 1
+    return run(x2d, w.qdata, w.scale)
+
+
 def packed_proj(x: jax.Array, w) -> jax.Array:
     """x[..., d] @ w[d, n] where w may be a PackedWeight.
 
     Dense weights pass straight to einsum (the training path pays only an
-    isinstance check). PackedWeight + decode-sized x (≤ 8 rows) runs the
-    Pallas streaming kernel; anything else dequantizes and uses the MXU.
-
-    tp>1 serving also takes the dequantize path: a bare pallas_call has
-    no GSPMD partitioning rule, so the sharded qdata/scale operands would
-    be replicated (or rejected) instead of streamed per-shard — the
-    per-shard int8 HBM residency is kept either way, the dequant just
-    runs in XLA until the kernel grows a shard_map wrapper.
+    isinstance check — or a decomposed collective-matmul ring when the
+    tensor_parallel.overlap_comm scope routes the call site through
+    parallel/tensor_overlap instead). PackedWeight + decode-sized x (≤ 8
+    rows) runs the Pallas streaming kernel; under tp>1 the kernel runs
+    per-shard inside a full-manual shard_map when the leaf remembers its
+    partition spec (PackedWeight.pspec) and the packed geometry divides.
+    Anything else dequantizes and uses the MXU.
     """
     if not isinstance(w, PackedWeight):
         return jnp.einsum("...d,dn->...n", x, w)
@@ -179,14 +300,17 @@ def packed_proj(x: jax.Array, w) -> jax.Array:
         rows <= matvec_max_rows()
         and w.qdata.ndim == 3
         and w.scale.shape[-1] % 128 == 0
-        and (topo is None or topo.world_size == 1)
     ):
         N = w.scale.shape[-1]
         x2d = x.reshape(rows, x.shape[-1])
-        y = _packed_matvec(
-            x2d, w.qdata, w.scale,
-            block_n=_pick_block_n(N, x.shape[-1]),
-            nibbles=w.nibbles,
-        )
-        return y.reshape(*lead, N)
+        if topo is None or topo.world_size == 1:
+            _STREAM_TRACES["single"] += 1
+            y = _packed_matvec(
+                x2d, w.qdata, w.scale,
+                block_n=_pick_block_n(N, x.shape[-1]),
+                nibbles=w.nibbles,
+            )
+            return y.reshape(*lead, N)
+        if _sharded_matvec_ok(w, topo, x2d.shape[1]):
+            return _packed_matvec_sharded(x2d, w, topo).reshape(*lead, N)
     return jnp.einsum("...d,dn->...n", x, w.dequantize())

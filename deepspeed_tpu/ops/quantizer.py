@@ -87,14 +87,21 @@ class PackedWeight:
     deepspeed/inference quantization).
     """
 
-    def __init__(self, qdata, scale, shape, bits, dtype, nibbles=False):
+    def __init__(self, qdata, scale, shape, bits, dtype, nibbles=False,
+                 pspec=None):
         self.qdata, self.scale = qdata, scale
         self.shape, self.bits, self.dtype = tuple(shape), int(bits), dtype
         self.nibbles = bool(nibbles)  # int4 pairs packed into int8 bytes
+        # the ORIGINAL dense weight's PartitionSpec when served sharded
+        # (tp>1): packed_proj's shard_map wrapper needs it at trace time
+        # (tracers don't carry committed shardings) to run the streaming
+        # matvec kernel per-shard instead of dequantizing full width
+        self.pspec = pspec
 
     def tree_flatten(self):
         return ((self.qdata, self.scale),
-                (self.shape, self.bits, self.dtype, self.nibbles))
+                (self.shape, self.bits, self.dtype, self.nibbles,
+                 self.pspec))
 
     @classmethod
     def tree_unflatten(cls, aux, children):

@@ -4,3 +4,8 @@ from .sequence import (  # noqa: F401
     sp_attention,
     ulysses_attention,
 )
+from .tensor_overlap import (  # noqa: F401
+    allgather_matmul,
+    matmul_reducescatter,
+    overlap_scope,
+)

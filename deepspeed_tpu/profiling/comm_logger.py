@@ -58,6 +58,12 @@ class CommsLogger:
         self.offload_bytes_out = 0
         self.offload_slots = 0
         self.offload_slot_bytes = 0
+        # decomposed-TP ring accounting (tensor_parallel.overlap_comm):
+        # scanned layers trace their ring hops once, so the hook bus
+        # under-counts them — the engine reports the analytic per-step
+        # wire bytes here (parallel/tensor_overlap.ring_wire_bytes_per_step)
+        self.ring_steps = 0
+        self.ring_bytes = 0
         self._t0 = time.time()
         register_comm_hook(self._on_op)
 
@@ -102,6 +108,27 @@ class CommsLogger:
         """Peak concurrent offload-stream bytes (slots × one layer slice)."""
         return self.offload_slots * self.offload_slot_bytes
 
+    # ------------------------------------------------- TP overlap ring stats
+    def record_ring(self, nbytes_per_step: int, steps: int = 1) -> None:
+        """Account ``steps`` steps of decomposed-TP ring traffic:
+        ``nbytes_per_step`` is the per-device wire total across all rings
+        of one optimizer step (forward + transposed backward hops)."""
+        self.ring_steps += steps
+        self.ring_bytes += nbytes_per_step * steps
+
+    def ring_summary(self, duration_s: Optional[float] = None) -> str:
+        """One line of ring-wire accounting (empty when no rings ran)."""
+        if not self.ring_steps:
+            return ""
+        dur = self.elapsed if duration_s is None else duration_s
+        per_step = self.ring_bytes / self.ring_steps
+        gbps = self.ring_bytes * 8 / dur / 1e9 if dur > 0 else 0.0
+        return (
+            f"tp-overlap rings: {self.ring_steps} steps, "
+            f"{per_step / 2**20:.2f} MiB/step wire (fwd+bwd hops), "
+            f"{gbps:.2f} Gbps over window"
+        )
+
     @staticmethod
     def offload_overlap_ratio(serial_step_s: float, overlapped_step_s: float,
                               dma_s: float) -> float:
@@ -126,6 +153,12 @@ class CommsLogger:
             return 0.0
         ratio = (serial_step_s - overlapped_step_s) / dma_s
         return max(0.0, min(1.0, ratio))
+
+    # Same arithmetic reads for any hidden-stream A/B: "the comm wall time
+    # that stopped being exposed, over the comm there was to hide" — the
+    # decomposed-TP ring A/B (bench.py BENCH_TP_OVERLAP_AB) passes the
+    # estimated ring-wire seconds as the third argument.
+    overlap_ratio = offload_overlap_ratio
 
     def offload_summary(self, duration_s: Optional[float] = None) -> str:
         """One line of offload-stream accounting (empty when none ran)."""
@@ -180,6 +213,9 @@ class CommsLogger:
         off = self.offload_summary(duration_s=dur)
         if off:
             lines.append(off)
+        ring = self.ring_summary(duration_s=dur)
+        if ring:
+            lines.append(ring)
         return "\n".join(lines)
 
     def log_summary(self, axis_sizes: Optional[Dict[str, int]] = None) -> None:

@@ -282,6 +282,31 @@ class TpuEngine:
             sp_cfg = from_ds_config(config.sparse_attention)
             if sp_cfg is not None:
                 self._sparse_impl = make_attention_impl(sp_cfg)
+        # ---- decomposed TP collective matmul (tensor_parallel.overlap_comm:
+        # parallel/tensor_overlap.py). Scoped at trace time like the kernel
+        # selectors; the knob defaults off pending an on-chip A/B. ----------
+        ov = config.tensor_parallel.overlap_comm
+        self.tp_overlap = ov if (ov.enabled and topology.tp_size > 1) else None
+        if ov.enabled and topology.tp_size <= 1:
+            log_dist(
+                "tensor_parallel.overlap_comm: tp_size == 1 on this "
+                "topology — nothing to decompose, knob ignored"
+            )
+        if self.tp_overlap is not None:
+            from ..parallel.tensor_overlap import static_widths_divide
+
+            mc = getattr(model, "config", None)
+            if mc is not None and not static_widths_divide(
+                mc, topology.tp_size
+            ):
+                log_dist(
+                    "tensor_parallel.overlap_comm: a projection width does "
+                    f"not divide tp={topology.tp_size} — the rings could "
+                    "never engage, so the knob is disabled (the residual "
+                    "stream would otherwise pay the (sp, tp) layout for "
+                    "nothing)"
+                )
+                self.tp_overlap = None
         self.pld = None
         if config.progressive_layer_drop.enabled:
             from .progressive_layer_drop import ProgressiveLayerDrop
@@ -655,6 +680,8 @@ class TpuEngine:
             params, opt_state, loss_scale, jnp.zeros((), jnp.int32)
         )
         self.offload_stream = self._compute_offload_stream()
+        self._tp_overlap_streams = {}
+        self.tp_overlap_stream = self._compute_tp_overlap_stream()
         if self._nvme_swapper is not None and not self.abstract:
             # optimizer state lives on disk between steps (reference:
             # partitioned_optimizer_swapper); swapped in around each update
@@ -729,13 +756,64 @@ class TpuEngine:
             "double_buffer": self._bucketed_opt.double_buffer,
         }
 
-    def _record_offload_stream(self, steps: int = 1):
+    def _record_offload_stream(self, steps: int = 1, batch=None):
         if self.comm_logger is not None and self.offload_stream:
             s = self.offload_stream
             self.comm_logger.record_offload(
                 s["bytes_in"], s["bytes_out"],
                 slots=s["slots"], slot_bytes=s["slot_bytes"], steps=steps,
             )
+        if self.comm_logger is not None and self.tp_overlap is not None:
+            # ring bytes scale with the ACTUAL batch sequence length (and
+            # vanish when it stops dividing the ring) — derive it from the
+            # prepared batch rather than trusting model max_seq_len
+            seq = None
+            if isinstance(batch, dict):
+                ids = batch.get("input_ids")
+                if ids is not None and getattr(ids, "shape", None):
+                    seq = int(ids.shape[-1])
+            s = self._tp_overlap_stream_for(seq)
+            if s:
+                self.comm_logger.record_ring(
+                    s["bytes_per_step"], steps=steps
+                )
+
+    def _tp_overlap_stream_for(self, seq):
+        """The analytic ring stream at one sequence length (cached)."""
+        if seq is None:
+            return self.tp_overlap_stream
+        if seq not in self._tp_overlap_streams:
+            self._tp_overlap_streams[seq] = self._compute_tp_overlap_stream(
+                seq=seq
+            )
+        return self._tp_overlap_streams[seq]
+
+    def _compute_tp_overlap_stream(self, seq=None):
+        """Static per-step decomposed-ring wire bytes (None when overlap is
+        off, shapes keep the rings from engaging, or the model isn't
+        transformer-shaped). Reported to the comms logger per step — the
+        trace-time hook bus under-counts scanned layers (a scan body
+        traces once), so the analytic figure is the honest per-step
+        number. ``seq`` defaults to the model's max_seq_len (the bench
+        estimate); recording passes the actual batch length."""
+        if self.tp_overlap is None:
+            return None
+        from ..parallel.tensor_overlap import ring_wire_bytes_per_step
+
+        model_cfg = getattr(self.model, "config", None)
+        if model_cfg is None:
+            return None
+        return ring_wire_bytes_per_step(
+            model_cfg,
+            self.topology,
+            self.tp_overlap,
+            batch=self.config.train_micro_batch_size_per_gpu
+            * self.topology.data_shard_size,
+            seq=seq if seq is not None
+            else getattr(model_cfg, "max_seq_len", 0),
+            itemsize=jnp.dtype(self.compute_dtype).itemsize,
+            accum_steps=self.config.gradient_accumulation_steps,
+        )
 
     # ------------------------------------------------------------------ step
     def _device_params(self, params):
@@ -832,6 +910,9 @@ class TpuEngine:
         from ..ops.cross_entropy import fused_ce_scope
 
         stack.enter_context(fused_ce_scope(tk.fused_ce, tk.ce_chunk))
+        from ..parallel.tensor_overlap import overlap_scope
+
+        stack.enter_context(overlap_scope(self.tp_overlap))
         return stack
 
     def _loss_for(self, params, mb, key, scale, pld_keep=None, ltd_keep=None):
@@ -1355,7 +1436,7 @@ class TpuEngine:
             self._swap_out_opt(blocking=False)  # writes overlap next step
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
-        self._record_offload_stream()
+        self._record_offload_stream(batch=prepared)
         self._metrics = {k: v for k, v in metrics.items()}
         # only the fp16 path reads overflow on host — a host read here forces
         # a device sync every step and kills async dispatch overlap
@@ -1551,7 +1632,7 @@ class TpuEngine:
         self.state = TrainState(p, o, s, st)
         self.global_steps += steps
         self.micro_steps += steps * self.config.gradient_accumulation_steps
-        self._record_offload_stream(steps=steps)
+        self._record_offload_stream(steps=steps, batch=data)
         self.last_chain_metrics = ms
         # expose the final step's metrics where train_batch puts them
         self._metrics = {k: v[-1] for k, v in ms.items()}

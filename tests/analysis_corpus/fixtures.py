@@ -214,6 +214,65 @@ def pinned_host_compute_clean():
     return closed, kw, "R5"
 
 
+# --------------------------------------------------------------------- R3
+# decomposed collective matmul (parallel/tensor_overlap.py): the clean twin
+# traces the REAL ring program; the hazard is the same shape hand-rolled
+# with a raw lax.ppermute and a malformed ring (bypassing the
+# comm.collectives.permute construction-time contract — the exact mistake
+# the hook exists to prevent, kept detectable at lint time)
+def _overlap_topo():
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    return MeshTopology(dims=ParallelDims(dp=2, tp=4))
+
+
+def tp_overlap_malformed_ring():
+    topo = _overlap_topo()
+    tp = 4
+    # ring 0→1→2→3 closed back to 1 instead of 0: duplicate destination —
+    # two members send to one, the ring hangs on real ICI
+    perm = [(0, 1), (1, 2), (2, 3), (3, 1)]
+
+    def body(x, w):
+        i = lax.axis_index("tp")
+        m = x.shape[1]
+        out = jnp.zeros((x.shape[0], m * tp, w.shape[1]), x.dtype)
+        chunk, src = x, i
+        for s in range(tp):
+            out = lax.dynamic_update_slice(
+                out, jnp.einsum("bsk,kn->bsn", chunk, w), (0, src * m, 0)
+            )
+            if s < tp - 1:
+                chunk = lax.ppermute(chunk, "tp", perm)
+                src = (src - 1) % tp
+        return out
+
+    fn = shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=(P(("dp",), "tp", None), P(None, "tp")),
+        out_specs=P("dp", None, "tp"),
+        axis_names=set(topo.mesh.axis_names),
+        check_vma=False,
+    )
+    x = jax.ShapeDtypeStruct((2, 8, 16), jnp.float32)
+    w = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    return jax.make_jaxpr(fn)(x, w), {"mesh": topo.mesh}, "R3"
+
+
+def tp_overlap_ring_clean():
+    from deepspeed_tpu.parallel.tensor_overlap import allgather_matmul
+
+    topo = _overlap_topo()
+
+    def prog(x, w):
+        return allgather_matmul(x, w, topo, chunks=2, bidirectional=True)
+
+    x = jax.ShapeDtypeStruct((2, 8, 16), jnp.float32)
+    w = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    return jax.make_jaxpr(prog)(x, w), {"mesh": topo.mesh}, "R3"
+
+
 HAZARDS = [
     stacked_dim0_drift,
     missing_psum_grads,
@@ -221,6 +280,7 @@ HAZARDS = [
     read_after_donate,
     truncated_master,
     pinned_host_compute,
+    tp_overlap_malformed_ring,
 ]
 
 CLEAN_TWINS = [
@@ -230,4 +290,5 @@ CLEAN_TWINS = [
     read_after_donate_clean,
     truncated_master_clean,
     pinned_host_compute_clean,
+    tp_overlap_ring_clean,
 ]

@@ -165,3 +165,46 @@ def test_pallas_compiler_params_prefers_modern_name(monkeypatch):
 
     monkeypatch.setattr(pltpu, "CompilerParams", Modern, raising=False)
     assert jax_compat.pallas_tpu_compiler_params() is Modern
+
+
+# ------------------------------------- decomposed collective matmul branch
+def test_tensor_overlap_is_full_manual_on_this_jax(devices8):
+    """The decomposed collective matmul (parallel/tensor_overlap.py) is a
+    FULL-manual shard_map program, so it must actually run through the
+    legacy 0.4.x fallback on this image (a partial-manual formulation
+    would be refused with NotImplementedError — never a C++ abort)."""
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+    from deepspeed_tpu.parallel.tensor_overlap import allgather_matmul
+
+    topo = MeshTopology(dims=ParallelDims(tp=4, dp=2))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.float32)
+    out = jax.jit(lambda a, b: allgather_matmul(a, b, topo))(x, w)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.einsum("bsk,kn->bsn", x, w))
+    )
+
+
+def test_tensor_overlap_passes_full_axis_set_to_modern_shard_map(
+    monkeypatch, devices8
+):
+    """On modern jax the shim forwards axis_names — the overlap wrapper
+    must request EVERY mesh axis (full manual), which is also what makes
+    the legacy fallback legal."""
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+    from deepspeed_tpu.parallel import tensor_overlap
+
+    seen = {}
+
+    def fake_shard_map(f, mesh, in_specs, out_specs, **kw):
+        seen.update(kw)
+        raise RuntimeError("stop after capture")
+
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map, raising=False)
+    topo = MeshTopology(dims=ParallelDims(tp=4, dp=2))
+    with pytest.raises(RuntimeError, match="stop after capture"):
+        tensor_overlap.allgather_matmul(
+            jnp.zeros((2, 8, 16)), jnp.zeros((16, 8)), topo
+        )
+    assert seen["axis_names"] == set(topo.mesh.axis_names)
+    assert seen["check_vma"] is False
