@@ -27,10 +27,12 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def peak_flops_per_chip() -> float:
-    """bf16 peak for the local chip generation."""
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    peaks = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
-    return peaks.get(gen, 197e12)
+    """bf16 peak for the local chip generation — delegates to the
+    planner's hardware table (analysis/cost/hardware.py) so bench MFU
+    and plan rooflines price the same machine from one table."""
+    from deepspeed_tpu.analysis.cost import HardwareModel
+
+    return HardwareModel.detect().peak_flops
 
 
 def smoke_mode() -> bool:
@@ -340,6 +342,34 @@ def offload_report(engine, step_s: float):
     }
 
 
+def plan_summary(engine, name: str, measured_step_s=None):
+    """The analysis/cost planner's budget for the running engine — same
+    table `tools/shardplan.py` and `shardlint --report` print, so every
+    BENCH run banks the predicted-vs-measured step pair (the planner's
+    roofline vs the wall clock). Best-effort: a bench number must never
+    die on its accounting line."""
+    try:
+        from deepspeed_tpu.analysis import format_plan_table, plan_engine
+
+        plan = plan_engine(engine, source=name)
+        print(format_plan_table([plan]), file=sys.stderr)
+        out = {
+            "est_step_s": round(plan.est_step_s, 4),
+            "peak_hbm_gib": round(plan.peak_hbm_bytes / 2**30, 2),
+            "ici_gib_per_step": round(
+                sum(plan.ici_bytes.values()) / 2**30, 3
+            ),
+        }
+        if measured_step_s:
+            out["vs_measured"] = round(plan.est_step_s / measured_step_s, 4)
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: plan_summary failed: "
+              f"{(str(e).splitlines() or [repr(e)])[0][:160]}",
+              file=sys.stderr)
+        return None
+
+
 def load_sweep_seed(dp: int, B: int):
     """The committed sweep winner (SWEEP_BEST.json, written by
     tools/sweep_train.py) becomes the ladder's first rung — on the 16GB
@@ -497,6 +527,8 @@ def main():
     # relay RPC before each dispatch (a real input pipeline prefetches).
     dt = time_chained_steps(engine, data)
     offload = offload_report(engine, dt)
+    # price the MEASURED engine before any A/B rebuild swaps it out
+    plan = plan_summary(engine, f"bench-{model_tag()}", measured_step_s=dt)
     if offload is not None and os.environ.get("BENCH_OFFLOAD_AB") and big:
         # A/B the double-buffer knob in the same window: rebuild the
         # engine (the 1.5B state doesn't fit twice) with the knob flipped
@@ -579,6 +611,8 @@ def main():
     }
     if offload is not None:
         result["offload"] = offload
+    if plan is not None:
+        result["plan"] = plan
     if not smoke:
         note = bank_record(cls, result)
         if note:

@@ -12,24 +12,48 @@ rule registry:
 - R3 collective-topology (rules/topology.py)
 - R4 donation/aliasing   (rules/aliasing.py)
 - R5 precision-policy    (rules/precision.py)
+- R6 hbm-capacity        (rules/capacity.py — needs an HBM budget)
+- R7 redundant-reshard   (rules/reshard.py)
+- R8 overlap-budget      (rules/overlap_budget.py — needs declared streams)
+
+The sibling :mod:`.cost` package is the static HBM-capacity +
+collective-cost planner rules R6/R8 consume: :func:`plan_engine` /
+:func:`plan_config` / :func:`plan_jaxpr` budget a config's per-device
+bytes, ICI traffic and roofline step time from the same traced jaxpr.
 
 Entry points: :func:`lint_jaxpr` (any program), :func:`lint_engine` (a
 constructed engine, including ``abstract_init=True`` shells that never
 materialized state), :func:`lint_config` (config → abstract engine →
-lint). CLI: ``tools/shardlint.py``. Rule catalog: ``docs/shardlint.md``.
+lint). CLIs: ``tools/shardlint.py``, ``tools/shardplan.py``. Rule
+catalog: ``docs/shardlint.md``; planner semantics:
+``docs/memory_planner.md``.
 """
 
 from .base import Finding, LintContext, Report
+from .cost import (
+    HardwareModel,
+    Plan,
+    format_plan_table,
+    plan_config,
+    plan_engine,
+    plan_jaxpr,
+)
 from .rules import register_rule, registered_rules
 from .shardlint import lint_config, lint_engine, lint_jaxpr
 
 __all__ = [
     "Finding",
+    "HardwareModel",
     "LintContext",
+    "Plan",
     "Report",
+    "format_plan_table",
     "lint_config",
     "lint_engine",
     "lint_jaxpr",
+    "plan_config",
+    "plan_engine",
+    "plan_jaxpr",
     "register_rule",
     "registered_rules",
 ]

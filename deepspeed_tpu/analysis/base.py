@@ -59,7 +59,25 @@ class LintContext:
         precision (R5).
     source: display name for findings.
 
-    (Donation hazards need no context field: R4 reads each pjit
+    Cost-planner evidence (analysis/cost — rules R6/R8):
+
+    hbm_budget_bytes: per-device HBM capacity to check the plan's peak
+        against; None disables R6 entirely (the default — only
+        budget-aware drivers like tools/shardplan.py set it).
+    streams: declared-overlapped analytic streams keyed by name, each
+        ``{"kind": "offload"|"ici", "bytes_per_step": float,
+        "per_device_bytes_per_step": float, "overlapped": bool, ...}``
+        (engine.analytic_streams() produces them). R8 checks every
+        ``overlapped`` stream against the step's compute window.
+    hardware: a cost.HardwareModel (None → detect per-generation
+        defaults + bench env overrides).
+    donated_invars: flat top-level invar indices donated at the jit
+        boundary (the planner's buffer-reuse credit follows R4's
+        donation reasoning).
+    invar_groups: state-group name → flat invar index range, so the
+        plan's byte columns split exactly like the engine state.
+
+    (Other donation hazards need no context field: R4 reads each pjit
     equation's own ``donated_invars`` param, and the jit-boundary
     donation audit lives in shardlint.lint_engine, which has the engine.)
     """
@@ -69,6 +87,12 @@ class LintContext:
     arg_shardings: Dict[Any, Any] = field(default_factory=dict)
     master_pairs: Sequence[Tuple[int, int, str]] = ()
     source: str = "<jaxpr>"
+    hbm_budget_bytes: Optional[float] = None
+    streams: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    hardware: Any = None
+    donated_invars: Sequence[int] = ()
+    invar_groups: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    _plan: Any = field(default=None, repr=False, compare=False)
 
     @property
     def jaxpr(self):
@@ -89,6 +113,7 @@ class Report:
     def __init__(self):
         self.findings: List[Finding] = []
         self.sources: List[Dict[str, Any]] = []
+        self.plans: List[Any] = []  # cost.Plan rows (shardlint --report)
 
     def add_source(self, name: str, seconds: float, n_findings: int,
                    skipped: Optional[str] = None) -> None:
@@ -111,11 +136,14 @@ class Report:
         return not self.errors
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "ok": self.ok,
             "findings": [f.to_dict() for f in self.findings],
             "sources": list(self.sources),
         }
+        if self.plans:
+            out["plans"] = [p.to_dict() for p in self.plans]
+        return out
 
     def to_json(self, **kw) -> str:
         return json.dumps(self.to_dict(), **kw)
@@ -130,6 +158,10 @@ class Report:
                 f"shardlint: {s['source']}: {status} in {s['seconds']:.2f}s"
             )
         lines.extend(f.format() for f in self.findings)
+        if self.plans:
+            from .cost import format_plan_table
+
+            lines.append(format_plan_table(self.plans))
         lines.append(
             "shardlint: "
             + ("CLEAN" if self.ok else f"{len(self.errors)} error finding(s)")

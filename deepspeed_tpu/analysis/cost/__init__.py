@@ -1,0 +1,46 @@
+"""analysis.cost — static HBM-capacity + collective-cost planner.
+
+Walks the same traced jaxpr shardlint lints (no execution, CPU mesh) and
+computes per device: state bytes from the ShapeDtypeStructs and their
+shardings, the activation live-set high-water mark through
+scan/remat/donation, collective scratch and offload double-buffer slots,
+plus an ICI/FLOPs/HBM roofline step estimate. Rules R6 (capacity) and
+R8 (overlap-budget) consume it; ``tools/shardplan.py`` is the CLI.
+"""
+
+from .hardware import HardwareModel
+from .pipeline import (
+    auto_chunk,
+    boundary_bytes,
+    growth_per_microbatch,
+    pipeline_temp_bytes,
+    stash_boundaries,
+)
+from .planner import (
+    Plan,
+    format_plan_table,
+    plan_config,
+    plan_engine,
+    plan_for_context,
+    plan_jaxpr,
+)
+from .walk import JaxprWalker, WalkStats, device_bytes, dimspec_from_sharding
+
+__all__ = [
+    "HardwareModel",
+    "JaxprWalker",
+    "Plan",
+    "WalkStats",
+    "auto_chunk",
+    "boundary_bytes",
+    "device_bytes",
+    "dimspec_from_sharding",
+    "format_plan_table",
+    "growth_per_microbatch",
+    "pipeline_temp_bytes",
+    "plan_config",
+    "plan_engine",
+    "plan_for_context",
+    "plan_jaxpr",
+    "stash_boundaries",
+]

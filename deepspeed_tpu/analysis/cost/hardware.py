@@ -1,0 +1,59 @@
+"""Hardware envelope the planner prices programs against.
+
+One dataclass, per-TPU-generation defaults (same table bench.py uses for
+MFU), env-var overrides shared with the bench legs so a BENCH run and its
+shardplan prediction price the same machine:
+
+- ``PALLAS_AXON_TPU_GEN``    chip generation ("v4"/"v5e"/"v5p"/"v6e")
+- ``BENCH_HOST_BW_GBS``      host<->HBM DMA link, GB/s (offload stream)
+- ``BENCH_ICI_BW_GBS``       per-link ICI bandwidth, GB/s (ring hops)
+- ``SHARDPLAN_HBM_GB``       per-device HBM capacity budget override
+
+Everything is per *device*: the planner's byte and flop counts are
+per-device too, so seconds fall straight out.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+_GIB = float(1 << 30)
+
+# (bf16 peak flops, HBM bytes, HBM GB/s) per generation. Peaks match
+# bench.peak_flops_per_chip; HBM bandwidth is the published spec number.
+_GEN_TABLE = {
+    "v4": (275e12, 32 * _GIB, 1228e9),
+    "v5e": (197e12, 16 * _GIB, 819e9),
+    "v5p": (459e12, 95 * _GIB, 2765e9),
+    "v6e": (918e12, 32 * _GIB, 1640e9),
+}
+
+
+@dataclass
+class HardwareModel:
+    """Per-device capability numbers the roofline and budget checks use."""
+
+    gen: str = "v5e"
+    peak_flops: float = 197e12        # bf16 MXU peak, flops/s
+    hbm_bytes: float = 16 * _GIB      # HBM capacity (the default R6 budget)
+    hbm_bw: float = 819e9             # HBM bandwidth, bytes/s
+    ici_bw: float = 45e9              # per-link ICI bandwidth, bytes/s
+    host_bw: float = 32e9             # host DMA link, bytes/s
+
+    @classmethod
+    def detect(cls) -> "HardwareModel":
+        """Defaults for the local generation + the bench env overrides."""
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        flops, hbm, hbm_bw = _GEN_TABLE.get(gen, _GEN_TABLE["v5e"])
+        hbm_gb = os.environ.get("SHARDPLAN_HBM_GB")
+        if hbm_gb:
+            hbm = float(hbm_gb) * _GIB
+        return cls(
+            gen=gen,
+            peak_flops=flops,
+            hbm_bytes=hbm,
+            hbm_bw=hbm_bw,
+            ici_bw=float(os.environ.get("BENCH_ICI_BW_GBS", 45)) * 1e9,
+            host_bw=float(os.environ.get("BENCH_HOST_BW_GBS", 32)) * 1e9,
+        )

@@ -1,0 +1,85 @@
+"""Pipeline-schedule activation-memory estimator (analysis.cost).
+
+The closed-form side of what ``tools/pipe_memory.py`` *measures*: the
+scan+ppermute schedule's stash growth per microbatch, per policy, in
+"boundary activation" units (one microbatch's stage-boundary tensor,
+``mb*S*D*itemsize``). Constants come from the committed measurement
+(docs/pipe_memory.md, perf/pipe_memory.json); the tool now prints its
+measured column next to this prediction, so drift between the model and
+XLA's actual buffer assignment is visible the day it appears.
+
+Folded here from the tool (one estimator, satellite of ISSUE 4):
+``auto_chunk`` (the 1f1b default chunk), ``boundary_bytes``,
+``stash_boundaries`` (per-policy growth law), ``pipeline_temp_bytes``
+and ``growth_per_microbatch`` (the slope fit the tool reports).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+# measured per-policy constants (docs/pipe_memory.md, virtual 8-CPU mesh):
+# base = M-independent recompute working set + schedule plumbing;
+# slope = boundary activations stashed per extra microbatch
+_POLICY_LAWS = {
+    # policy-key: (base_boundaries, slope_per_microbatch)
+    "none": (65.0, 45.6),       # full layer internals stored every tick
+    "gpipe": (41.0, 2.0),       # per-tick remat: carry + ppermute pair
+    # 1f1b's M-dependent term is slope*M on TOP of the chunk-boundary
+    # carries (ticks/C + 2C) the branch below adds
+    "1f1b": (47.0, 1.1),        # chunked checkpoint: sqrt-ish growth
+}
+
+
+def auto_chunk(pp: int, M: int) -> int:
+    """The 1f1b default tick chunk C ≈ max(pp, sqrt(T/2)) (mirrors
+    PipelineModule.pipeline_loss)."""
+    ticks = M + pp - 1
+    return max(pp, int(round((ticks / 2) ** 0.5)))
+
+
+def boundary_bytes(mb: int, seq: int, hidden: int, itemsize: int = 4) -> int:
+    """One stage-boundary activation: [mb, S, D] at ``itemsize``."""
+    return int(mb) * int(seq) * int(hidden) * int(itemsize)
+
+
+def stash_boundaries(pp: int, M: int, policy: str = "1f1b",
+                     tick_chunk: Optional[int] = None) -> float:
+    """Predicted peak stash in boundary-activation units.
+
+    ``policy`` is "none" (no remat — O(M) with the full-internals
+    constant), "gpipe" (per-tick remat, plain scan — 2/microbatch), or
+    "1f1b" (chunked checkpoint — T/C + 2C boundaries of M-dependent
+    stash). An explicit ``tick_chunk`` pins C (config
+    ``pipeline.activation_checkpoint_interval``)."""
+    if policy not in _POLICY_LAWS:
+        raise ValueError(
+            f"policy must be one of {sorted(_POLICY_LAWS)}, got {policy!r}"
+        )
+    base, slope = _POLICY_LAWS[policy]
+    ticks = M + pp - 1
+    if policy == "1f1b":
+        c = tick_chunk or auto_chunk(pp, M)
+        # chunk-boundary carries + one replayed chunk + input stream copy
+        return base + ticks / max(c, 1) + 2 * c + slope * M
+    return base + slope * M
+
+
+def pipeline_temp_bytes(pp: int, M: int, mb: int, seq: int, hidden: int,
+                        policy: str = "1f1b",
+                        tick_chunk: Optional[int] = None,
+                        itemsize: int = 4) -> float:
+    """Predicted peak temp bytes of one fwd+bwd pipeline pass."""
+    return stash_boundaries(pp, M, policy, tick_chunk) * boundary_bytes(
+        mb, seq, hidden, itemsize
+    )
+
+
+def growth_per_microbatch(points: Sequence[Tuple[int, float]],
+                          act_bytes: float) -> float:
+    """Endpoint slope of (M, temp_bytes) in boundary-activation units —
+    the figure the measurement tool prints per (pp, policy) leg."""
+    (m0, t0), (m1, t1) = points[0], points[-1]
+    if m1 == m0 or act_bytes <= 0:
+        return 0.0
+    return (t1 - t0) / (m1 - m0) / act_bytes

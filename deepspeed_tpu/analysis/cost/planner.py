@@ -1,0 +1,321 @@
+"""shardplan: static HBM-capacity + collective-cost plans from jaxprs.
+
+The same traced step program shardlint lints (abstract evaluation, CPU
+mesh, no state materialization) carries everything needed to budget a
+config before anything compiles:
+
+- parameter / optimizer / master-weight bytes come straight from the
+  state ShapeDtypeStructs and their shardings (exact — the planner and
+  the materialized state count the same shard shapes);
+- the activation live-set high-water mark, collective scratch and
+  offload double-buffer slots come from the sharding-aware liveness walk
+  (:mod:`.walk`), which credits donated and rotating buffers the same
+  way rule R4 reasons about them;
+- every named collective is classified by mesh axis into ICI wire bytes
+  and hop counts, and combined with MXU FLOPs and HBM traffic into an
+  analytic roofline step time (ZeRO++ arXiv:2306.10209 and T3
+  arXiv:2401.16677 both budget training as bytes-moved vs
+  compute-available; this makes that budget a checkable artifact).
+
+Rules R6 (capacity) and R8 (overlap-budget) consume plans through
+:func:`plan_for_context`; ``tools/shardplan.py`` and ``tools/shardlint.py
+--report`` print them as per-config tables.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .hardware import HardwareModel
+from .walk import JaxprWalker, device_bytes, dimspec_from_sharding
+
+_GIB = float(1 << 30)
+
+
+def _leaf_device_bytes(aval, sharding, mesh_sizes) -> float:
+    """Per-device bytes of one state leaf under its (known) sharding."""
+    shape = tuple(getattr(aval, "shape", ()))
+    dtype = getattr(aval, "dtype", np.float32)
+    shard_shape = None
+    if sharding is not None:
+        try:
+            shard_shape = sharding.shard_shape(shape)
+        except Exception:  # noqa: BLE001 — duck-typed / abstract shardings
+            shard_shape = None
+    if shard_shape is not None:
+        return float(np.prod(shard_shape, dtype=np.int64) or 1) * float(
+            np.dtype(dtype).itemsize
+        )
+    spec = dimspec_from_sharding(sharding, len(shape), mesh_sizes) \
+        if sharding is not None else (1,) * len(shape)
+    return device_bytes(shape, dtype, spec)
+
+
+@dataclass
+class Plan:
+    """One config's static per-device budget (bytes, flops, seconds)."""
+
+    source: str = "<jaxpr>"
+    hardware: HardwareModel = field(default_factory=HardwareModel)
+    n_devices: int = 1
+    # ---- per-device HBM bytes ------------------------------------------
+    param_bytes: float = 0.0         # model parameter leaves (device)
+    opt_bytes: float = 0.0           # optimizer-state leaves (device)
+    master_bytes: float = 0.0        # f32 master subset of the above
+    other_state_bytes: float = 0.0   # loss scale, step counter, ...
+    host_state_bytes: float = 0.0    # pinned-host-resident state (not HBM)
+    act_peak_bytes: float = 0.0      # live-set high-water beyond state
+    collective_scratch_bytes: float = 0.0
+    offload_inflight_bytes: float = 0.0   # double-buffer slots (informational)
+    peak_hbm_bytes: float = 0.0
+    # ---- per-device per-step cost --------------------------------------
+    flops: float = 0.0
+    hbm_traffic_bytes: float = 0.0
+    ici_bytes: Dict[str, float] = field(default_factory=dict)
+    ici_hops: Dict[str, int] = field(default_factory=dict)
+    compute_s: float = 0.0
+    hbm_s: float = 0.0
+    ici_s: float = 0.0
+    est_step_s: float = 0.0
+    streams: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    seconds: float = 0.0             # planner wall time
+
+    @property
+    def state_bytes(self) -> float:
+        return self.param_bytes + self.opt_bytes + self.other_state_bytes
+
+    @property
+    def ici_bytes_total(self) -> float:
+        return sum(self.ici_bytes.values())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "source": self.source,
+            "n_devices": self.n_devices,
+            "param_bytes": round(self.param_bytes),
+            "opt_bytes": round(self.opt_bytes),
+            "master_bytes": round(self.master_bytes),
+            "other_state_bytes": round(self.other_state_bytes),
+            "host_state_bytes": round(self.host_state_bytes),
+            "act_peak_bytes": round(self.act_peak_bytes),
+            "collective_scratch_bytes": round(self.collective_scratch_bytes),
+            "offload_inflight_bytes": round(self.offload_inflight_bytes),
+            "peak_hbm_bytes": round(self.peak_hbm_bytes),
+            "peak_hbm_gib": round(self.peak_hbm_bytes / _GIB, 3),
+            "flops": self.flops,
+            "hbm_traffic_bytes": round(self.hbm_traffic_bytes),
+            "ici_bytes": {k: round(v) for k, v in self.ici_bytes.items()},
+            "ici_hops": dict(self.ici_hops),
+            "compute_s": round(self.compute_s, 6),
+            "hbm_s": round(self.hbm_s, 6),
+            "ici_s": round(self.ici_s, 6),
+            "est_step_s": round(self.est_step_s, 6),
+            "hbm_budget_gib": round(self.hardware.hbm_bytes / _GIB, 3),
+            "seconds": round(self.seconds, 3),
+        }
+
+
+_TABLE_COLS = (
+    ("config", 34), ("params", 9), ("opt", 9), ("acts", 9), ("peak", 9),
+    ("budget", 9), ("ICI/step", 9), ("est step", 9),
+)
+
+
+def format_plan_table(plans: Sequence[Plan]) -> str:
+    """The per-config table shardplan, shardlint --report and the bench
+    legs all print: params / opt-state / activations / peak GiB, ICI
+    GiB/step, est. step seconds."""
+    head = "".join(
+        f"{name:<{w}}" if i == 0 else f"{name:>{w}}"
+        for i, (name, w) in enumerate(_TABLE_COLS)
+    )
+    lines = [head, "-" * len(head)]
+    for p in plans:
+        gib = lambda b: f"{b / _GIB:.2f}G"  # noqa: E731
+        over = p.peak_hbm_bytes > p.hardware.hbm_bytes
+        lines.append(
+            f"{p.source[:33]:<34}"
+            f"{gib(p.param_bytes):>9}"
+            f"{gib(p.opt_bytes):>9}"
+            f"{gib(p.act_peak_bytes):>9}"
+            f"{gib(p.peak_hbm_bytes):>9}"
+            f"{gib(p.hardware.hbm_bytes) + ('!' if over else ''):>9}"
+            f"{gib(p.ici_bytes_total):>9}"
+            f"{p.est_step_s:>8.4f}s"
+        )
+    return "\n".join(lines)
+
+
+def plan_jaxpr(
+    closed_jaxpr,
+    *,
+    mesh=None,
+    arg_shardings: Optional[Dict[Any, Any]] = None,
+    donated_invars: Sequence[int] = (),
+    invar_groups: Optional[Dict[str, Tuple[int, int]]] = None,
+    streams: Optional[Dict[str, Dict[str, Any]]] = None,
+    hardware: Optional[HardwareModel] = None,
+    source: str = "<jaxpr>",
+) -> Plan:
+    """Budget one traced program. All inputs are the same evidence
+    shardlint already collects (see LintContext); ``invar_groups`` maps
+    state-group names ("params"/"opt_state"/...) to flat invar index
+    ranges so the byte columns split exactly like the engine state."""
+    t0 = time.time()
+    hw = hardware or HardwareModel.detect()
+    arg_shardings = arg_shardings or {}
+    jaxpr = closed_jaxpr.jaxpr
+    mesh_sizes: Dict[str, int] = {}
+    if mesh is not None:
+        try:
+            mesh_sizes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        except Exception:  # noqa: BLE001
+            mesh_sizes = {}
+    n_devices = 1
+    for v in mesh_sizes.values():
+        n_devices *= v
+
+    invars = list(jaxpr.invars)
+    donated = set(int(i) for i in donated_invars)
+    groups = invar_groups or {}
+
+    def group_of(i: int) -> str:
+        for name, (lo, hi) in groups.items():
+            if lo <= i < hi:
+                return name
+        return "other"
+
+    in_specs, host_flags, donated_flags = [], [], []
+    device_state = 0.0  # state bytes the walk's live set holds on device
+    plan = Plan(source=source, hardware=hw, n_devices=n_devices)
+    for i, v in enumerate(invars):
+        s = arg_shardings.get(v)
+        nd = len(getattr(v.aval, "shape", ()))
+        in_specs.append(
+            dimspec_from_sharding(s, nd, mesh_sizes)
+            if s is not None else (1,) * nd
+        )
+        is_host = getattr(s, "memory_kind", None) == "pinned_host"
+        host_flags.append(is_host)
+        # without explicit donation evidence, assume the caller keeps its
+        # argument buffers resident (the conservative direction for an
+        # OOM check) — only engine-traced donated_invars earn the credit
+        donated_flags.append(i in donated)
+        b = _leaf_device_bytes(v.aval, s, mesh_sizes)
+        g = group_of(i)
+        if is_host:
+            plan.host_state_bytes += b
+            continue
+        if g == "params":
+            plan.param_bytes += b
+        elif g == "opt_state":
+            plan.opt_bytes += b
+        elif g in ("loss_scale", "step"):
+            plan.other_state_bytes += b
+        if g in ("params", "opt_state", "loss_scale", "step"):
+            device_state += b
+        if g in ("params", "opt_state") and str(
+            getattr(v.aval, "dtype", "")
+        ) == "float32":
+            plan.master_bytes += b
+
+    walker = JaxprWalker(mesh_sizes)
+    peak, _ = walker.walk(
+        jaxpr, in_specs, donated=donated_flags, host_resident=host_flags
+    )
+    st = walker.stats
+    # the walk counts its own live inputs; state not in the walk's device
+    # live set (host leaves) was handled above
+    plan.collective_scratch_bytes = st.collective_scratch
+    plan.peak_hbm_bytes = peak + st.collective_scratch
+    plan.act_peak_bytes = max(peak - device_state, 0.0)
+    plan.flops = st.flops
+    plan.hbm_traffic_bytes = st.hbm_bytes
+    plan.ici_bytes = dict(st.ici_bytes)
+    plan.ici_hops = dict(st.ici_hops)
+    plan.streams = dict(streams or {})
+    for s in plan.streams.values():
+        if s.get("kind") == "offload":
+            plan.offload_inflight_bytes = max(
+                plan.offload_inflight_bytes,
+                float(s.get("per_device_inflight_bytes", 0.0)),
+            )
+    plan.compute_s = st.flops / hw.peak_flops if hw.peak_flops else 0.0
+    plan.hbm_s = st.hbm_bytes / hw.hbm_bw if hw.hbm_bw else 0.0
+    plan.ici_s = max(
+        (b / hw.ici_bw for b in st.ici_bytes.values()), default=0.0
+    ) if hw.ici_bw else 0.0
+    plan.est_step_s = max(plan.compute_s, plan.hbm_s, plan.ici_s)
+    plan.seconds = time.time() - t0
+    return plan
+
+
+def plan_for_context(ctx) -> Plan:
+    """The plan for one LintContext (cached on the context — R6 and R8
+    share a single walk)."""
+    cached = getattr(ctx, "_plan", None)
+    if cached is not None:
+        return cached
+    hw = ctx.hardware or HardwareModel.detect()
+    if ctx.hbm_budget_bytes is not None:
+        from dataclasses import replace
+
+        hw = replace(hw, hbm_bytes=float(ctx.hbm_budget_bytes))
+    plan = plan_jaxpr(
+        ctx.closed_jaxpr,
+        mesh=ctx.mesh,
+        arg_shardings=ctx.arg_shardings,
+        donated_invars=ctx.donated_invars,
+        invar_groups=ctx.invar_groups,
+        streams=ctx.streams,
+        hardware=hw,
+        source=ctx.source,
+    )
+    ctx._plan = plan
+    return plan
+
+
+# ------------------------------------------------------------- engine plans
+def plan_engine(engine, source: Optional[str] = None,
+                hardware: Optional[HardwareModel] = None) -> Plan:
+    """Trace one engine's train step (abstract — works on concrete and
+    ``abstract_init=True`` engines alike) and budget it."""
+    from ..shardlint import trace_train_step
+
+    closed, arg_shardings, _pairs, _out, meta = trace_train_step(engine)
+    streams = {}
+    if hasattr(engine, "analytic_streams"):
+        streams = engine.analytic_streams(include_potential=True)
+    return plan_jaxpr(
+        closed,
+        mesh=engine.topology.mesh,
+        arg_shardings=arg_shardings,
+        donated_invars=meta.get("donated_invars", ()),
+        invar_groups=meta.get("invar_groups", {}),
+        streams=streams,
+        hardware=hardware,
+        source=source or f"engine[{type(engine).__name__}]",
+    )
+
+
+def plan_config(config, model=None, topology=None,
+                source: Optional[str] = None,
+                hardware: Optional[HardwareModel] = None) -> Plan:
+    """ds_config (+ model) → abstract engine → plan. Mirrors
+    :func:`analysis.lint_config`; nothing materializes."""
+    import deepspeed_tpu
+
+    if model is None:
+        raise ValueError("plan_config requires a model (the step program "
+                         "is model-shaped)")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=config, topology=topology, abstract_init=True
+    )
+    try:
+        return plan_engine(engine, source=source, hardware=hardware)
+    finally:
+        engine.destroy()

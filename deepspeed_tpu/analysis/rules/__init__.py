@@ -64,3 +64,6 @@ from . import closure  # noqa: E402,F401  (R2)
 from . import topology  # noqa: E402,F401  (R3)
 from . import aliasing  # noqa: E402,F401  (R4)
 from . import precision  # noqa: E402,F401  (R5)
+from . import capacity  # noqa: E402,F401  (R6)
+from . import reshard  # noqa: E402,F401  (R7)
+from . import overlap_budget  # noqa: E402,F401  (R8)

@@ -1,0 +1,245 @@
+"""R7 — redundant reshard: data movement the partitioner should never emit.
+
+Three statically visible waste shapes, each a pure cost bug (the program
+is correct, the bytes are not):
+
+(a) transpose∘transpose composing to the identity permutation around a
+    placement cast (``transpose → reshard → transpose⁻¹``), where the
+    intermediates have no other consumer — the cast forces both copies
+    to materialize. A *bare* adjacent pair is NOT flagged: autodiff
+    emits those naturally and XLA's algebraic simplifier cancels them
+    for free — only the reshard-pinned form actually moves bytes;
+(b) back-to-back placement casts (``device_put`` / ``sharding_constraint``
+    chains) where the second cast restores the sharding the value
+    already had before the first (an A→B→A reshard ping-pong, each leg a
+    collective on a sharded mesh) or repeats the same target twice;
+(c) a degenerate gather-then-slice: an ``all_gather`` over a mesh axis
+    whose only consumer is a slice that takes back exactly the
+    pre-gather shard — (n−1)/n of the wire bytes bought nothing.
+
+A deliberate *no-op* re-put (putting a value to the sharding evidence
+says it already has, with no second cast — the engine's resting re-put
+that keeps scan carries closed) is NOT flagged: XLA compiles it away and
+R2 depends on it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..base import ERROR, Finding, LintContext, sharding_fingerprint
+from ..trace import Literal, iter_jaxprs
+from . import register_rule
+
+_PLACEMENT = ("device_put", "sharding_constraint")
+
+
+def _consumers(jaxpr) -> Dict[Any, int]:
+    """var → number of uses at this level (outvars count as a use)."""
+    n: Dict[Any, int] = {}
+    for eqn in jaxpr.eqns:
+        for a in eqn.invars:
+            if not isinstance(a, Literal):
+                n[a] = n.get(a, 0) + 1
+    for a in jaxpr.outvars:
+        if not isinstance(a, Literal):
+            n[a] = n.get(a, 0) + 1
+    return n
+
+
+def _is_own_shard_index(var, prod, _depth: int = 0) -> bool:
+    """True when a dynamic-slice start operand is provably the device's
+    OWN ``axis_index`` (allowing literal scaling/casting — shard-size
+    multiples), i.e. the self-selection that makes a gather-then-slice
+    degenerate. Neighbor arithmetic (±1, mod) or anything else we cannot
+    prove disqualifies — a cross-shard fetch means the gather is
+    load-bearing."""
+    if _depth > 8:
+        return False
+    if isinstance(var, Literal):
+        return True  # constant component of the start tuple
+    e = prod.get(var)
+    if e is None:
+        return False
+    n = e.primitive.name
+    if n == "axis_index":
+        return True
+    if n in ("convert_element_type", "broadcast_in_dim", "reshape",
+             "squeeze"):
+        return _is_own_shard_index(e.invars[0], prod, _depth + 1)
+    if n == "mul":
+        nonlit = [a for a in e.invars if not isinstance(a, Literal)]
+        if len(nonlit) == 1:
+            return _is_own_shard_index(nonlit[0], prod, _depth + 1)
+    if n == "select_n" and len(e.invars) == 3:
+        # dynamic_slice's wrap-around normalization select(x<0, x+L, x)
+        # is an identity for an in-range x — see through it when pred
+        # and both branches root at the SAME base var
+        pred, a0, a1 = e.invars
+        pe = prod.get(pred)
+        if (
+            pe is not None
+            and pe.primitive.name == "lt"
+            and not isinstance(a0, Literal)
+            and pe.invars
+            and pe.invars[0] is a0
+        ):
+            ae = prod.get(a1)
+            if ae is not None and ae.primitive.name == "add":
+                nonlit = [v for v in ae.invars
+                          if not isinstance(v, Literal)]
+                if len(nonlit) == 1 and nonlit[0] is a0:
+                    return _is_own_shard_index(a0, prod, _depth + 1)
+    return False
+
+
+def _placement_target(eqn, outvar) -> Optional[Any]:
+    if eqn.primitive.name == "sharding_constraint":
+        return eqn.params.get("sharding")
+    if eqn.primitive.name == "device_put":
+        devices = eqn.params.get("devices") or ()
+        try:
+            idx = list(eqn.outvars).index(outvar)
+        except ValueError:
+            return None
+        if idx < len(devices):
+            return devices[idx]
+    return None
+
+
+@register_rule("R7", "redundant-reshard")
+def redundant_reshard(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for jaxpr, path in iter_jaxprs(ctx.closed_jaxpr):
+        prod: Dict[Any, Any] = {}
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                prod[ov] = eqn
+        uses = _consumers(jaxpr)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            where = f"{path}/{name}"
+            if not eqn.invars or isinstance(eqn.invars[0], Literal):
+                continue
+            src = eqn.invars[0]
+            inner = prod.get(src)
+            # (a) transpose(reshard(transpose(x))) == reshard(x): the
+            # placement cast between the pair pins both copies — XLA
+            # cannot cancel them. (A bare adjacent pair IS cancelled by
+            # the algebraic simplifier, so it is not flagged.)
+            if name == "transpose" and inner is not None \
+                    and uses.get(src, 0) == 1:
+                chain = inner
+                saw_cast = False
+                while (
+                    chain is not None
+                    and chain.primitive.name in _PLACEMENT
+                    and chain.invars
+                    and not isinstance(chain.invars[0], Literal)
+                    and uses.get(chain.invars[0], 0) == 1
+                ):
+                    saw_cast = True
+                    chain = prod.get(chain.invars[0])
+                if (
+                    saw_cast
+                    and chain is not None
+                    and chain.primitive.name == "transpose"
+                ):
+                    p_out = eqn.params["permutation"]
+                    p_in = chain.params["permutation"]
+                    if [p_in[p] for p in p_out] == list(range(len(p_out))):
+                        findings.append(Finding(
+                            rule="R7",
+                            severity=ERROR,
+                            message=(
+                                "transpose∘reshard∘transpose composes to a "
+                                f"resharded identity (inner {tuple(p_in)}, "
+                                f"outer {tuple(p_out)}) with single-use "
+                                "intermediates — the placement cast forces "
+                                "two full copies of the tensor that a "
+                                "reshard of the original would avoid"
+                            ),
+                            where=where,
+                        ))
+            # (b) placement-cast chains: A→B→A round trip or duplicate
+            if (
+                name in _PLACEMENT
+                and inner is not None
+                and inner.primitive.name in _PLACEMENT
+                and uses.get(src, 0) == 1
+            ):
+                outer_t = _placement_target(eqn, eqn.outvars[0])
+                inner_t = _placement_target(inner, src)
+                inner_src = (
+                    inner.invars[0]
+                    if inner.invars and not isinstance(inner.invars[0], Literal)
+                    else None
+                )
+                before = ctx.arg_shardings.get(inner_src)
+                fp_outer = sharding_fingerprint(outer_t) if outer_t else None
+                fp_inner = sharding_fingerprint(inner_t) if inner_t else None
+                fp_before = sharding_fingerprint(before) if before else None
+                if fp_outer is not None and fp_outer == fp_inner:
+                    findings.append(Finding(
+                        rule="R7",
+                        severity=ERROR,
+                        message=(
+                            f"two chained placement casts to the same "
+                            f"sharding {fp_outer[0]} (memory {fp_outer[1]}) "
+                            "— the first is dead weight"
+                        ),
+                        where=where,
+                    ))
+                elif (
+                    fp_outer is not None
+                    and fp_before is not None
+                    and fp_outer == fp_before
+                    and fp_inner is not None
+                    and fp_inner != fp_outer
+                ):
+                    findings.append(Finding(
+                        rule="R7",
+                        severity=ERROR,
+                        message=(
+                            f"reshard ping-pong: value resharded "
+                            f"{fp_before[0]} → {fp_inner[0]} → {fp_outer[0]} "
+                            "with no use in between — both legs are "
+                            "wasted collectives"
+                        ),
+                        where=where,
+                    ))
+            # (c) all_gather whose only consumer dynamic-slices the
+            # device's OWN shard back out. A static slice (fixed shard —
+            # a broadcast) or a neighbor-indexed fetch keeps the gather
+            # load-bearing and is NOT flagged.
+            if name == "dynamic_slice" and inner is not None \
+                    and inner.primitive.name == "all_gather" \
+                    and uses.get(src, 0) == 1:
+                out_aval = eqn.outvars[0].aval
+                pre_aval = inner.invars[0].aval \
+                    if inner.invars and not isinstance(
+                        inner.invars[0], Literal
+                    ) else None
+                pre_gather = tuple(pre_aval.shape) if pre_aval is not None \
+                    else None
+                if (
+                    pre_aval is not None
+                    and out_aval.size == pre_aval.size
+                    and out_aval.size < src.aval.size
+                    and all(
+                        _is_own_shard_index(a, prod)
+                        for a in eqn.invars[1:]
+                    )
+                ):
+                    findings.append(Finding(
+                        rule="R7",
+                        severity=ERROR,
+                        message=(
+                            "all_gather output is consumed only by a slice "
+                            f"returning the pre-gather shard {pre_gather} — "
+                            "the gather's wire bytes bought nothing "
+                            "(degenerate gather-then-slice)"
+                        ),
+                        where=where,
+                    ))
+    return findings

@@ -31,6 +31,11 @@ def lint_jaxpr(
     master_pairs: Sequence = (),
     source: str = "<jaxpr>",
     only: Optional[Sequence[str]] = None,
+    hbm_budget_bytes: Optional[float] = None,
+    streams: Optional[Dict[str, Any]] = None,
+    hardware=None,
+    donated_invars: Sequence[int] = (),
+    invar_groups: Optional[Dict[str, Any]] = None,
 ) -> List[Finding]:
     """Run the rule registry over one traced program."""
     ctx = LintContext(
@@ -39,6 +44,11 @@ def lint_jaxpr(
         arg_shardings=arg_shardings or {},
         master_pairs=tuple(master_pairs),
         source=source,
+        hbm_budget_bytes=hbm_budget_bytes,
+        streams=dict(streams or {}),
+        hardware=hardware,
+        donated_invars=tuple(donated_invars),
+        invar_groups=dict(invar_groups or {}),
     )
     return run_rules(ctx, only=only)
 
@@ -83,11 +93,16 @@ def _flat_with_paths(tree):
 
 
 def trace_train_step(engine):
-    """(closed_jaxpr, arg_shardings, master_pairs, out_shape).
+    """(closed_jaxpr, arg_shardings, master_pairs, out_shape, meta).
 
     Traces ``engine._train_step`` (the body of the jitted train step —
     same program the runtime compiles) with ShapeDtypeStruct state and
     batch: abstract evaluation, nothing touches devices.
+
+    ``meta`` carries the jit-boundary evidence the cost planner needs:
+    ``invar_groups`` (state-group name → flat invar index range) and
+    ``donated_invars`` (the state leaves ``_jit_train`` donates — its
+    ``donate_argnums=(0, 1, 2, 3)`` covers params/opt/scale/step).
     """
     from ..models.sharding import use_topology
 
@@ -120,11 +135,11 @@ def trace_train_step(engine):
     # master pairs: f32 params/opt leaves must round-trip at full precision
     master_pairs = []
     out_leaves = jax.tree_util.tree_leaves(out_shape)
+    n_p = len(jax.tree_util.tree_leaves(params))
+    n_o = len(jax.tree_util.tree_leaves(opt_state))
     if len(flat_args) == len(invars) and len(out_leaves) == len(
         closed.jaxpr.outvars
     ):
-        n_p = len(jax.tree_util.tree_leaves(params))
-        n_o = len(jax.tree_util.tree_leaves(opt_state))
         # step outputs: (params, opt, scale, step, metrics) — same leading
         # structure as the inputs
         for i in range(n_p + n_o):
@@ -132,7 +147,29 @@ def trace_train_step(engine):
             if leaf.dtype == jnp.float32 and out_leaves[i].dtype == jnp.float32:
                 if leaf.shape == out_leaves[i].shape:
                     master_pairs.append((i, i, arg_paths[i]))
-    return closed, arg_shardings, master_pairs, out_shape
+
+    # planner metadata: which flat invars are which state group, and which
+    # the jitted step donates (donate_argnums=(0,1,2,3) — the whole state)
+    n_s = len(jax.tree_util.tree_leaves(loss_scale))
+    n_step = 1
+    n_batch = len(jax.tree_util.tree_leaves(batch))
+    bounds = [
+        ("params", n_p), ("opt_state", n_o), ("loss_scale", n_s),
+        ("step", n_step), ("batch", n_batch),
+    ]
+    invar_groups, lo = {}, 0
+    for name, n in bounds:
+        invar_groups[name] = (lo, lo + n)
+        lo += n
+    meta = (
+        {
+            "invar_groups": invar_groups,
+            "donated_invars": tuple(range(n_p + n_o + n_s + n_step)),
+        }
+        if len(flat_args) == len(invars)
+        else {"invar_groups": {}, "donated_invars": ()}
+    )
+    return closed, arg_shardings, master_pairs, out_shape, meta
 
 
 def _engine_level_findings(engine, out_shape) -> List[Finding]:
@@ -192,32 +229,61 @@ def _engine_level_findings(engine, out_shape) -> List[Finding]:
 
 
 def lint_engine(engine, only: Optional[Sequence[str]] = None,
-                source: Optional[str] = None) -> Report:
-    """Trace + lint one engine's train step. Seconds on CPU."""
+                source: Optional[str] = None,
+                hbm_budget_bytes: Optional[float] = None,
+                hardware=None,
+                collect_plan: bool = False) -> Report:
+    """Trace + lint one engine's train step. Seconds on CPU.
+
+    ``hbm_budget_bytes`` arms rule R6 (static OOM-before-compile check);
+    ``collect_plan`` attaches the cost plan (analysis/cost) to the
+    report so drivers print the per-config budget table without tracing
+    twice. The engine's declared analytic streams (offload
+    double-buffer, decomposed-TP rings) feed rule R8 either way.
+    """
+    from .cost import plan_for_context
+
     report = Report()
     name = source or f"engine[{type(engine).__name__}]"
     t0 = time.time()
-    closed, arg_shardings, master_pairs, out_shape = trace_train_step(engine)
-    findings = lint_jaxpr(
-        closed,
+    closed, arg_shardings, master_pairs, out_shape, meta = trace_train_step(
+        engine
+    )
+    streams = (
+        engine.analytic_streams(include_potential=True)
+        if hasattr(engine, "analytic_streams")
+        else {}
+    )
+    ctx = LintContext(
+        closed_jaxpr=closed,
         mesh=engine.topology.mesh,
         arg_shardings=arg_shardings,
-        master_pairs=master_pairs,
+        master_pairs=tuple(master_pairs),
         source=name,
-        only=only,
+        hbm_budget_bytes=hbm_budget_bytes,
+        streams=streams,
+        hardware=hardware,
+        donated_invars=meta["donated_invars"],
+        invar_groups=meta["invar_groups"],
     )
+    findings = run_rules(ctx, only=only)
     for f in _engine_level_findings(engine, out_shape):
         if only is None or f.rule in only:
             f.source = name
             findings.append(f)
     report.extend(findings)
     report.add_source(name, time.time() - t0, len(findings))
+    if collect_plan:
+        report.plans.append(plan_for_context(ctx))
     return report
 
 
 def lint_config(config, model=None, topology=None,
                 only: Optional[Sequence[str]] = None,
-                source: Optional[str] = None) -> Report:
+                source: Optional[str] = None,
+                hbm_budget_bytes: Optional[float] = None,
+                hardware=None,
+                collect_plan: bool = False) -> Report:
     """Build an abstract engine (no state materialization) and lint it.
 
     ``config`` is anything DeepSpeedConfig accepts (dict / path). The
@@ -234,6 +300,10 @@ def lint_config(config, model=None, topology=None,
         model=model, config=config, topology=topology, abstract_init=True
     )
     try:
-        return lint_engine(engine, only=only, source=source)
+        return lint_engine(
+            engine, only=only, source=source,
+            hbm_budget_bytes=hbm_budget_bytes, hardware=hardware,
+            collect_plan=collect_plan,
+        )
     finally:
         engine.destroy()

@@ -376,6 +376,38 @@ class InferenceEngine:
             return jax.tree_util.tree_map_with_path(q, params, tp_specs)
         return jax.tree_util.tree_map_with_path(q, params)
 
+    # ------------------------------------------------- planner metadata
+    def analytic_streams(self, batch: int = 1, seq: Optional[int] = None,
+                         include_potential: bool = False):
+        """Declared analytic streams, same schema as the training
+        engine's (the shared planner / comms-logger / R8 contract). The
+        serving engine has one: the decomposed-TP ring hops of the
+        forward projections (no backward — the fwd wire figure)."""
+        streams = {}
+        if self.tp_overlap is not None:
+            from ..parallel.tensor_overlap import ring_wire_bytes_per_step
+
+            ring = ring_wire_bytes_per_step(
+                self.config,
+                self.topology,
+                self.tp_overlap,
+                batch=batch,
+                seq=seq if seq is not None else self.config.max_seq_len,
+                itemsize=jnp.dtype(self.dtype).itemsize,
+            )
+            if ring:
+                # ring carries a fwd+bwd "bytes_per_step"; the serving
+                # stream is fwd-only, so the overrides come AFTER the
+                # spread
+                streams["tp_ring"] = {
+                    **ring,
+                    "kind": "ici",
+                    "bytes_per_step": ring["fwd_bytes_per_step"],
+                    "per_device_bytes_per_step": ring["fwd_bytes_per_step"],
+                    "overlapped": True,
+                }
+        return streams
+
     # -------------------------------------------------------------- forward
     def forward(self, input_ids):
         """Plain logits forward (no cache) — reference engine __call__."""

@@ -116,6 +116,31 @@ class CommsLogger:
         self.ring_steps += steps
         self.ring_bytes += nbytes_per_step * steps
 
+    # ------------------------------------------------ shared stream intake
+    def record_streams(self, streams, steps: int = 1) -> None:
+        """ONE analytic-stream accounting path for every hidden-stream
+        subsystem: takes the normalized dict ``engine.analytic_streams()``
+        produces (also what the cost planner and rule R8 consume) and
+        dispatches to the per-kind accounting. Streams the mesh cannot
+        actually run (``assumed: True`` — the CPU lint mesh pricing a
+        declared offload) are planner-only and never recorded."""
+        for s in (streams or {}).values():
+            if not s or s.get("assumed"):
+                continue
+            kind = s.get("kind")
+            if kind == "offload":
+                # the schema guarantees bytes_per_step; the engine's
+                # richer dicts split it into in/out halves
+                half = s.get("bytes_per_step", 0) // 2
+                self.record_offload(
+                    s.get("bytes_in", half), s.get("bytes_out", half),
+                    slots=s.get("slots", 1),
+                    slot_bytes=s.get("slot_bytes", 0),
+                    steps=steps,
+                )
+            elif kind == "ici":
+                self.record_ring(s.get("bytes_per_step", 0), steps=steps)
+
     def ring_summary(self, duration_s: Optional[float] = None) -> str:
         """One line of ring-wire accounting (empty when no rings ran)."""
         if not self.ring_steps:
@@ -130,35 +155,36 @@ class CommsLogger:
         )
 
     @staticmethod
-    def offload_overlap_ratio(serial_step_s: float, overlapped_step_s: float,
-                              dma_s: float) -> float:
-        """Fraction of the offload DMA wall time hidden under compute,
-        from an A/B of the serial vs double-buffered step: the DMA that
-        stopped being exposed, over the DMA there was to hide. 0 = fully
-        serialized (the xprof_r5_1b_offload baseline), 1 = fully
-        overlapped. ``dma_s`` is the estimated one-way+back DMA wall time
-        (stream bytes / host-link bandwidth).
+    def overlap_ratio(serial_step_s: float, overlapped_step_s: float,
+                      stream_s: float) -> float:
+        """Fraction of a hidden stream's wall time actually hidden under
+        compute, from a serial-vs-overlapped A/B: the stream time that
+        stopped being exposed, over the stream there was to hide. 0 =
+        fully serialized, 1 = fully overlapped. ``stream_s`` is the
+        estimated stream wall time (bytes / link bandwidth) — the
+        offload A/B passes the host-DMA seconds, the decomposed-TP ring
+        A/B (bench.py BENCH_TP_OVERLAP_AB) the ring-wire seconds.
 
-        Degenerate inputs — an empty/zero-byte offload stream (dma_s 0),
-        unmeasured step times (0 or negative), NaN/inf from a failed A/B
-        leg — report 0.0 (nothing demonstrably overlapped) instead of
-        raising, so a bench summary never dies on its accounting line."""
-        vals = (serial_step_s, overlapped_step_s, dma_s)
+        This is THE hardened degenerate-input path (there is exactly
+        one): an empty/zero-byte stream (stream_s 0), unmeasured step
+        times (0 or negative), NaN/inf from a failed A/B leg, or
+        non-numeric inputs all report 0.0 (nothing demonstrably
+        overlapped) instead of raising, so a bench summary never dies on
+        its accounting line."""
+        vals = (serial_step_s, overlapped_step_s, stream_s)
         try:
             finite = all(math.isfinite(float(v)) for v in vals)
         except (TypeError, ValueError):
             return 0.0
-        if not finite or dma_s <= 0 or serial_step_s <= 0 \
+        if not finite or stream_s <= 0 or serial_step_s <= 0 \
                 or overlapped_step_s <= 0:
             return 0.0
-        ratio = (serial_step_s - overlapped_step_s) / dma_s
+        ratio = (serial_step_s - overlapped_step_s) / stream_s
         return max(0.0, min(1.0, ratio))
 
-    # Same arithmetic reads for any hidden-stream A/B: "the comm wall time
-    # that stopped being exposed, over the comm there was to hide" — the
-    # decomposed-TP ring A/B (bench.py BENCH_TP_OVERLAP_AB) passes the
-    # estimated ring-wire seconds as the third argument.
-    overlap_ratio = offload_overlap_ratio
+    # legacy spelling (PR-1 offload A/B callers): same function — the
+    # offload ratio IS the generic overlap ratio with DMA seconds
+    offload_overlap_ratio = overlap_ratio
 
     def offload_summary(self, duration_s: Optional[float] = None) -> str:
         """One line of offload-stream accounting (empty when none ran)."""

@@ -711,38 +711,62 @@ class TpuEngine:
             see_memory_usage("after engine init")
 
     # --------------------------------------------------- offload accounting
-    def _compute_offload_stream(self):
+    def _compute_offload_stream(self, assume_offload: bool = False):
         """Static per-step host↔HBM DMA byte counts for the bucketed
         offload stream (None when no pinned-host leaves stream). Every
         pinned-host stacked leaf is read in and written back once per
         optimizer step, so the counts come straight from the resting
         shardings; ``slot_bytes`` is one layer slice (the scan's in-flight
-        unit — double buffering keeps ``slots`` of them resident)."""
+        unit — double buffering keeps ``slots`` of them resident).
+
+        ``assume_offload=True`` prices the stream the *config declares*
+        even where the mesh has no memory kinds (the CPU lint mesh):
+        every stacked leaf the TPU run would pin to host counts, so the
+        planner and rule R8 can budget the 1.5B offload leg without a
+        chip. Per-device figures come from each leaf's shard shape."""
         if self._bucketed_opt is None or self.state is None:
             return None
         kind = self._opt_memory_kind or self._param_memory_kind
-        if kind is None:
+        zc = self.config.zero_config
+        opt_declared = zc.offload_optimizer.device in ("cpu", "nvme")
+        par_declared = zc.offload_param.enabled
+        if kind is None and not (
+            assume_offload and (opt_declared or par_declared)
+        ):
             return None  # CPU mesh: no memory kinds, nothing streams
         key = self._bucketed_opt.key
 
-        def host_bytes(tree):
-            n = 0
+        def stream_bytes(tree):
+            total = dev = 0
             for leaf in jax.tree_util.tree_leaves(tree):
-                if getattr(leaf.sharding, "memory_kind", None) == kind:
-                    n += leaf.size * leaf.dtype.itemsize
-            return n
+                streams_leaf = (
+                    getattr(leaf.sharding, "memory_kind", None) == kind
+                    if kind is not None
+                    else True  # assumed: the whole stacked group would pin
+                )
+                if not streams_leaf:
+                    continue
+                nbytes = leaf.size * leaf.dtype.itemsize
+                total += nbytes
+                try:
+                    shard = leaf.sharding.shard_shape(leaf.shape)
+                    dev += int(np.prod(shard)) * leaf.dtype.itemsize
+                except Exception:  # noqa: BLE001 — no sharding evidence
+                    dev += nbytes
+            return total, dev
 
-        state_b = (
-            host_bytes(self.state.opt_state[key])
-            if self._opt_memory_kind
-            else 0
+        state_b, state_dev = (
+            stream_bytes(self.state.opt_state[key])
+            if self._opt_memory_kind or (kind is None and opt_declared)
+            else (0, 0)
         )
-        param_b = (
-            host_bytes(self.state.params[key])
-            if self._param_memory_kind
-            else 0
+        param_b, param_dev = (
+            stream_bytes(self.state.params[key])
+            if self._param_memory_kind or (kind is None and par_declared)
+            else (0, 0)
         )
         total = state_b + param_b
+        per_dev = state_dev + param_dev
         if total == 0:
             return None
         n_layers = jax.tree_util.tree_leaves(self.state.params[key])[0].shape[0]
@@ -750,33 +774,71 @@ class TpuEngine:
         return {
             "bytes_in": total,
             "bytes_out": total,
+            "per_device_bytes_in": per_dev,
+            "per_device_bytes_out": per_dev,
             "slot_bytes": total // max(n_layers, 1),
             "slots": slots,
             "layers": int(n_layers),
             "double_buffer": self._bucketed_opt.double_buffer,
+            "assumed": kind is None,
         }
 
-    def _record_offload_stream(self, steps: int = 1, batch=None):
-        if self.comm_logger is not None and self.offload_stream:
-            s = self.offload_stream
-            self.comm_logger.record_offload(
-                s["bytes_in"], s["bytes_out"],
-                slots=s["slots"], slot_bytes=s["slot_bytes"], steps=steps,
+    def analytic_streams(self, seq=None, include_potential: bool = False):
+        """The engine's declared analytic streams, normalized for the
+        cost planner / rule R8 and the comms logger (ONE schema for every
+        hidden-stream subsystem): name → ``{"kind", "bytes_per_step",
+        "per_device_bytes_per_step", "overlapped", ...}``.
+
+        ``include_potential=True`` also prices streams the config
+        declares but this mesh cannot pin (the CPU lint mesh has no
+        memory kinds) — what the planner budgets; the comms logger only
+        ever records the actual (default) set."""
+        streams = {}
+        off = self.offload_stream
+        if off is None and include_potential:
+            off = self._compute_offload_stream(assume_offload=True)
+        if off:
+            total = off["bytes_in"] + off["bytes_out"]
+            per_dev = (
+                off.get("per_device_bytes_in", off["bytes_in"])
+                + off.get("per_device_bytes_out", off["bytes_out"])
             )
-        if self.comm_logger is not None and self.tp_overlap is not None:
-            # ring bytes scale with the ACTUAL batch sequence length (and
-            # vanish when it stops dividing the ring) — derive it from the
-            # prepared batch rather than trusting model max_seq_len
-            seq = None
-            if isinstance(batch, dict):
-                ids = batch.get("input_ids")
-                if ids is not None and getattr(ids, "shape", None):
-                    seq = int(ids.shape[-1])
-            s = self._tp_overlap_stream_for(seq)
-            if s:
-                self.comm_logger.record_ring(
-                    s["bytes_per_step"], steps=steps
-                )
+            streams["offload"] = {
+                "kind": "offload",
+                "bytes_per_step": total,
+                "per_device_bytes_per_step": per_dev,
+                "per_device_inflight_bytes": off["slots"] * off["slot_bytes"]
+                // max(self.topology.world_size, 1),
+                "overlapped": bool(off["double_buffer"]),
+                **off,
+            }
+        if self.tp_overlap is not None:
+            ring = self._tp_overlap_stream_for(seq)
+            if ring:
+                streams["tp_ring"] = {
+                    **ring,
+                    "kind": "ici",
+                    # ring_wire_bytes_per_step is already per device
+                    "bytes_per_step": ring["bytes_per_step"],
+                    "per_device_bytes_per_step": ring["bytes_per_step"],
+                    "overlapped": True,
+                }
+        return streams
+
+    def _record_offload_stream(self, steps: int = 1, batch=None):
+        if self.comm_logger is None:
+            return
+        # ring bytes scale with the ACTUAL batch sequence length (and
+        # vanish when it stops dividing the ring) — derive it from the
+        # prepared batch rather than trusting model max_seq_len
+        seq = None
+        if isinstance(batch, dict):
+            ids = batch.get("input_ids")
+            if ids is not None and getattr(ids, "shape", None):
+                seq = int(ids.shape[-1])
+        self.comm_logger.record_streams(
+            self.analytic_streams(seq=seq), steps=steps
+        )
 
     def _tp_overlap_stream_for(self, seq):
         """The analytic ring stream at one sequence length (cached)."""

@@ -9,6 +9,10 @@ trace-ready evidence of one statically-visible bug class:
 - ``read_after_donate``     R4: a rotating slot read after overwrite
 - ``truncated_master``      R5: f32 master rebuilt through bf16
 - ``pinned_host_compute``   R5: host-resident bytes fed to compute
+- ``hbm_over_budget``       R6: estimated peak exceeds the HBM budget
+- ``reshard_transpose_pair`` R7: transpose∘reshard∘transpose identity
+- ``unhideable_offload_stream`` R8: declared-overlapped stream bigger
+  than the compute window
 
 Each has a ``*_clean`` twin proving the rules don't fire on the fixed
 form. All fixtures trace on the 8-device CPU mesh (no execution).
@@ -273,6 +277,100 @@ def tp_overlap_ring_clean():
     return jax.make_jaxpr(prog)(x, w), {"mesh": topo.mesh}, "R3"
 
 
+# --------------------------------------------------------------------- R6
+def _budget_prog():
+    mesh = corpus_mesh()
+
+    def prog(x, w):
+        h = jnp.einsum("bk,kn->bn", x, w)
+        return (h * 2.0).sum()
+
+    x = jax.ShapeDtypeStruct((256, 512), jnp.float32)
+    w = jax.ShapeDtypeStruct((512, 512), jnp.float32)
+    return jax.make_jaxpr(prog)(x, w), mesh
+
+
+def hbm_over_budget():
+    # x+w+h ≈ 1.8 MiB live — a 64 KiB per-device budget cannot hold it
+    closed, mesh = _budget_prog()
+    return closed, {"mesh": mesh, "hbm_budget_bytes": 64 * 1024}, "R6"
+
+
+def hbm_over_budget_clean():
+    closed, mesh = _budget_prog()
+    return closed, {"mesh": mesh, "hbm_budget_bytes": 1 << 30}, "R6"
+
+
+# --------------------------------------------------------------------- R7
+def _reshard_pair(mesh, roundtrip: bool):
+    # the hazard: transpose → reshard → transpose⁻¹, all single-use —
+    # the placement cast pins both copies, so XLA cannot cancel the
+    # pair; resharding the ORIGINAL value costs half the copies. The
+    # clean twin does exactly that.
+    cast = NamedSharding(mesh, P(None, "dp"))
+
+    def prog(x):
+        if roundtrip:
+            y = jnp.transpose(x)
+            y = lax.with_sharding_constraint(y, cast)
+            z = jnp.transpose(y)
+        else:
+            z = lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp", None))
+            )
+        return z * 1.5
+
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    return jax.make_jaxpr(prog)(x)
+
+
+def reshard_transpose_pair():
+    mesh = corpus_mesh()
+    return _reshard_pair(mesh, True), {"mesh": mesh}, "R7"
+
+
+def reshard_transpose_pair_clean():
+    mesh = corpus_mesh()
+    return _reshard_pair(mesh, False), {"mesh": mesh}, "R7"
+
+
+# --------------------------------------------------------------------- R8
+def _declared_stream(nbytes: float):
+    mesh = corpus_mesh()
+
+    def prog(x, w):
+        return jnp.einsum("bk,kn->bn", x, w).sum()
+
+    x = jax.ShapeDtypeStruct((64, 128), jnp.float32)
+    w = jax.ShapeDtypeStruct((128, 128), jnp.float32)
+    closed = jax.make_jaxpr(prog)(x, w)
+    kw = {
+        "mesh": mesh,
+        "streams": {
+            "offload": {
+                "kind": "offload",
+                "bytes_per_step": nbytes,
+                "per_device_bytes_per_step": nbytes,
+                "overlapped": True,
+            }
+        },
+    }
+    return closed, kw
+
+
+def unhideable_offload_stream():
+    # 64 GiB/step over a 32 GB/s host link is ~2 s of DMA; the tiny
+    # matmul's compute window is microseconds — the overlap claim is
+    # statically false (the PERF_NOTES round-7 ceiling)
+    closed, kw = _declared_stream(64 * (1 << 30))
+    return closed, kw, "R8"
+
+
+def unhideable_offload_stream_clean():
+    closed, kw = _declared_stream(4 * 1024)  # 4 KiB hides under anything
+    return closed, kw, "R8"
+
+
 HAZARDS = [
     stacked_dim0_drift,
     missing_psum_grads,
@@ -281,6 +379,9 @@ HAZARDS = [
     truncated_master,
     pinned_host_compute,
     tp_overlap_malformed_ring,
+    hbm_over_budget,
+    reshard_transpose_pair,
+    unhideable_offload_stream,
 ]
 
 CLEAN_TWINS = [
@@ -291,4 +392,7 @@ CLEAN_TWINS = [
     truncated_master_clean,
     pinned_host_compute_clean,
     tp_overlap_ring_clean,
+    hbm_over_budget_clean,
+    reshard_transpose_pair_clean,
+    unhideable_offload_stream_clean,
 ]

@@ -227,6 +227,46 @@ def test_native_tfevents_writer_roundtrip(tmp_path):
     assert scalars["Train/lr"] > 0
 
 
+def test_overlap_ratio_is_the_single_hardened_path():
+    """ISSUE 4 satellite: the generic ``overlap_ratio`` IS the primary
+    (one hardened zero/NaN/None path); ``offload_overlap_ratio`` is the
+    same function under its legacy name, so the two can never drift."""
+    assert CommsLogger.overlap_ratio is CommsLogger.offload_overlap_ratio
+    r = CommsLogger.overlap_ratio
+    # the generic name carries the full degenerate-input hardening
+    assert r(4.0, 3.0, 2.0) == 0.5
+    assert r(4.0, 1.0, 2.0) == 1.0           # clamped at fully-hidden
+    assert r(4.0, 3.0, 0.0) == 0.0           # zero-byte stream
+    assert r(float("nan"), 3.0, 2.0) == 0.0  # failed A/B leg
+    assert r(None, 3.0, 2.0) == 0.0          # type junk
+    assert r("x", 3.0, 2.0) == 0.0
+
+
+def test_record_streams_shared_intake():
+    """engine.analytic_streams() → comm_logger.record_streams: ONE
+    accounting path for offload + ring streams; planner-only (assumed)
+    streams are never recorded."""
+    logger = CommsLogger()
+    try:
+        logger.record_streams({
+            "offload": {
+                "kind": "offload", "bytes_in": 100, "bytes_out": 60,
+                "slots": 2, "slot_bytes": 10, "overlapped": True,
+            },
+            "tp_ring": {"kind": "ici", "bytes_per_step": 7, "overlapped": True},
+            "ghost": {
+                "kind": "offload", "bytes_in": 999, "bytes_out": 999,
+                "assumed": True,  # CPU lint mesh pricing — planner-only
+            },
+        }, steps=3)
+    finally:
+        logger.stop()
+    assert logger.offload_steps == 3
+    assert logger.offload_bytes_in == 300 and logger.offload_bytes_out == 180
+    assert logger.offload_bytes_in_flight == 20
+    assert logger.ring_steps == 3 and logger.ring_bytes == 21
+
+
 def test_offload_overlap_ratio_degenerate_inputs():
     """ISSUE 2 satellite: zero-duration / empty offload streams and failed
     A/B legs must report 0.0 overlap, never raise."""

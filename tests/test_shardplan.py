@@ -1,0 +1,323 @@
+"""shardplan (analysis/cost) validation: exactness, XLA cross-checks, CLI.
+
+ISSUE 4 acceptance:
+- planner param/opt byte counts match the materialized state EXACTLY
+  (same shard shapes, same itemsizes);
+- the activation/peak-HBM estimate lands within ±15% of XLA's own
+  compiled accounting (``Compiled.memory_analysis()``) on the 410M
+  CPU-mesh bench leg;
+- planner FLOPs cross-check against the analytic flops_profiler;
+- ``tools/shardplan.py`` exits 0 on shipped configs and 1 when
+  ``--hbm-gb`` is set below a config's estimated peak (R6);
+- the pipeline stash estimator (folded in from tools/pipe_memory.py)
+  keeps the measured ordering and chunk law.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.analysis import lint_engine, plan_engine
+from deepspeed_tpu.analysis.cost import (
+    auto_chunk,
+    pipeline_temp_bytes,
+    stash_boundaries,
+)
+from deepspeed_tpu.analysis.shardlint import _as_sds, _batch_sds
+from deepspeed_tpu.models import gpt2
+
+pytestmark = pytest.mark.shardlint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE_CFG = {
+    "train_batch_size": 16,
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+}
+
+
+def _engine(cfg, model=None, abstract=True):
+    comm.destroy_process_group()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model or gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+        config=dict(cfg),
+        abstract_init=abstract,
+    )
+    return engine
+
+
+def _device0_bytes(tree):
+    """Materialized per-device bytes: what device 0 actually holds."""
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for sh in leaf.addressable_shards:
+            if sh.device == dev0:
+                total += sh.data.size * sh.data.dtype.itemsize
+    return total
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_planner_state_bytes_exact_vs_materialized(stage, devices8):
+    """param/opt byte columns == the bytes the real engine puts on a
+    device, to the byte, across ZeRO stages (replicated AND sharded)."""
+    engine = _engine(
+        dict(BASE_CFG, zero_optimization={"stage": stage}), abstract=False
+    )
+    plan = plan_engine(engine, source=f"stage{stage}")
+    assert plan.param_bytes == _device0_bytes(engine.state.params)
+    assert plan.opt_bytes == _device0_bytes(engine.state.opt_state)
+    engine.destroy()
+
+
+def test_planner_abstract_equals_concrete_state_bytes(devices8):
+    """The abstract_init shell plans the same bytes as a materialized
+    engine — the whole point of OOM-checking before compile."""
+    cfg = dict(BASE_CFG, zero_optimization={"stage": 3})
+    abstract = plan_engine(_engine(cfg, abstract=True))
+    concrete = plan_engine(_engine(cfg, abstract=False))
+    assert abstract.param_bytes == concrete.param_bytes
+    assert abstract.opt_bytes == concrete.opt_bytes
+
+
+def test_planner_peak_within_15pct_of_xla_410m(devices8):
+    """ISSUE 4 acceptance: peak-HBM estimate within ±15% of
+    ``compiled.memory_analysis()`` on the CPU-mesh 410M bench leg (the
+    exact program the lint traces — XLA CPU compiles it in seconds)."""
+    import bench
+
+    name, model, cfg = bench.lint_targets(len(jax.devices()))[0]
+    assert name == "bench-410m"
+    engine = _engine(cfg, model=model)
+    plan = plan_engine(engine, source=name)
+
+    state = engine.state
+    lowered = engine._jit_train.lower(
+        jax.tree.map(_as_sds, state.params),
+        jax.tree.map(_as_sds, state.opt_state),
+        state.loss_scale,
+        jax.ShapeDtypeStruct((), jnp.int32),
+        _batch_sds(engine),
+        jax.random.PRNGKey(0),
+        None,
+    )
+    ma = lowered.compile().memory_analysis()
+    if not getattr(ma, "temp_size_in_bytes", 0):
+        pytest.skip("XLA does not report memory analysis on this backend")
+    xla_peak = (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        + ma.output_size_in_bytes
+        - ma.alias_size_in_bytes
+    )
+    ratio = plan.peak_hbm_bytes / xla_peak
+    assert 0.85 <= ratio <= 1.15, (
+        f"plan {plan.peak_hbm_bytes / 2**30:.2f} GiB vs XLA "
+        f"{xla_peak / 2**30:.2f} GiB (ratio {ratio:.3f})"
+    )
+    # and the state columns equal XLA's argument accounting (exactness
+    # again, now against the compiler's own number — XLA's figure also
+    # counts the batch/rng arguments, a fraction of a percent here)
+    args_ratio = plan.state_bytes / ma.argument_size_in_bytes
+    assert 0.97 <= args_ratio <= 1.0
+
+
+def test_planner_flops_cross_check_vs_flops_profiler(devices8):
+    """Planner MXU flops (counted dot-by-dot off the traced step, per
+    device) agree with the analytic flops_profiler (fwd+bwd = 3x fwd,
+    whole model) within 25% on a small dense decoder."""
+    from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+    model = gpt2(
+        "gpt2-tiny", vocab_size=512, max_seq_len=64, num_layers=4,
+        num_heads=4, hidden_size=128, intermediate_size=512,
+    )
+    cfg = dict(
+        BASE_CFG,
+        train_batch_size=8,
+        train_micro_batch_size_per_gpu=1,
+        zero_optimization={"stage": 0},
+    )
+    engine = _engine(cfg, model=model)
+    plan = plan_engine(engine)
+    B, S = 8, 64
+    analytic, _macs, _params = get_model_profile(model, B, S, fwd_only=False)
+    counted = plan.flops * plan.n_devices  # planner is per-device
+    assert 0.75 <= counted / analytic <= 1.25, (counted, analytic)
+
+
+def test_plan_reports_offload_and_ring_streams(devices8):
+    """The engine's declared analytic streams ride into the plan (and
+    into R8): the double-buffered offload leg prices its host stream
+    even on the CPU mesh (assumed), the tp-overlap leg its ring."""
+    import bench
+
+    targets = {n: (m, c) for n, m, c in bench.lint_targets(len(jax.devices()))}
+    model, cfg = targets["bench-1b-offload-db"]
+    plan = plan_engine(_engine(cfg, model=model), source="db")
+    off = plan.streams["offload"]
+    assert off["overlapped"] and off["assumed"] and off["kind"] == "offload"
+    assert off["per_device_bytes_per_step"] > 0
+    assert plan.offload_inflight_bytes > 0
+
+    model, cfg = targets["bench-410m-tp-overlap"]
+    plan = plan_engine(_engine(cfg, model=model), source="tp")
+    ring = plan.streams["tp_ring"]
+    assert ring["overlapped"] and ring["kind"] == "ici"
+    assert plan.ici_bytes_total > 0  # the walk saw the ppermute hops
+
+
+def test_r6_fires_only_with_budget(devices8):
+    """No budget → R6 silent; a budget below the estimated peak → R6
+    error naming the breakdown."""
+    engine = _engine(dict(BASE_CFG, zero_optimization={"stage": 0}))
+    clean = lint_engine(engine, only=["R6"])
+    assert clean.ok and not clean.findings
+    engine2 = _engine(dict(BASE_CFG, zero_optimization={"stage": 0}))
+    report = lint_engine(engine2, only=["R6"], hbm_budget_bytes=1024)
+    assert [f.rule for f in report.findings] == ["R6"]
+    assert "exceeds" in report.findings[0].message
+
+
+def test_r7_flags_put_chain_and_gather_slice(devices8):
+    """R7 unit coverage beyond the corpus pair: duplicate placement-cast
+    chains and the degenerate all_gather-then-slice."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.analysis import lint_jaxpr
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    s = NamedSharding(mesh, P("dp"))
+
+    def dup_put(x):
+        return jax.device_put(jax.device_put(x, s), s) * 2.0
+
+    closed = jax.make_jaxpr(dup_put)(jax.ShapeDtypeStruct((8, 4), jnp.float32))
+    findings = lint_jaxpr(closed, mesh=mesh, source="dup-put")
+    assert any(f.rule == "R7" for f in findings), [f.format() for f in findings]
+
+    def gather_slice(x):
+        def body(xs):
+            full = jax.lax.all_gather(xs, "dp")           # [4, n, k]
+            return jax.lax.dynamic_slice(
+                full, (jax.lax.axis_index("dp"), 0, 0), (1,) + xs.shape
+            )[0]
+
+        fn = shard_map(
+            body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            axis_names={"dp", "tp"}, check_vma=False,
+        )
+        return fn(x)
+
+    closed = jax.make_jaxpr(gather_slice)(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    )
+    findings = lint_jaxpr(closed, mesh=mesh, source="gather-slice")
+    assert any(f.rule == "R7" for f in findings), [f.format() for f in findings]
+
+    # neighbor exchange — same shapes, but the slice fetches the NEXT
+    # device's shard, so the gather is load-bearing and R7 must stay quiet
+    def neighbor_slice(x):
+        def body(xs):
+            full = jax.lax.all_gather(xs, "dp")
+            nxt = (jax.lax.axis_index("dp") + 1) % 4
+            return jax.lax.dynamic_slice(
+                full, (nxt, 0, 0), (1,) + xs.shape
+            )[0]
+
+        fn = shard_map(
+            body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            axis_names={"dp", "tp"}, check_vma=False,
+        )
+        return fn(x)
+
+    closed = jax.make_jaxpr(neighbor_slice)(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    )
+    findings = lint_jaxpr(closed, mesh=mesh, source="neighbor-slice")
+    assert not any(f.rule == "R7" for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+def test_shardplan_cli_budget_exit_codes(devices8, tmp_path):
+    """The CLI contract: exit 0 on a shipped config, exit 1 when
+    --hbm-gb undercuts its estimated peak, plan table in the JSON."""
+    cfg = os.path.join(REPO, "examples", "ds_config_zero3.json")
+    out = tmp_path / "plan.json"
+    t0 = time.time()
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "shardplan.py"), cfg,
+         "--json", str(out)],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    payload = json.loads(out.read_text())
+    assert payload["ok"] and payload["plans"]
+    row = payload["plans"][0]
+    assert row["peak_hbm_bytes"] > 0 and row["est_step_s"] >= 0
+
+    over = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "shardplan.py"), cfg,
+         "--hbm-gb", "0.0001"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert over.returncode == 1, over.stdout + over.stderr
+    assert "R6" in over.stdout
+    assert time.time() - t0 < 120.0  # two cold CLI runs stay snappy
+
+
+def test_pipeline_estimator_laws():
+    """The folded-in pipe-memory math: chunk law unchanged, no-remat
+    grows fastest, the 1f1b chunked law beats the plain scan at scale,
+    and byte scaling is linear in the boundary activation."""
+    # auto_chunk mirrors the tool's historical formula
+    for pp in (2, 4):
+        for M in (2, 8, 32):
+            ticks = M + pp - 1
+            assert auto_chunk(pp, M) == max(pp, int(round((ticks / 2) ** 0.5)))
+    for M in (8, 16, 32):
+        none_ = stash_boundaries(2, M, "none")
+        gpipe = stash_boundaries(2, M, "gpipe")
+        chunked = stash_boundaries(2, M, "1f1b")
+        assert none_ > gpipe
+        assert chunked < none_
+    # growth: gpipe is ~2/microbatch, 1f1b sub-linear beyond it
+    g32 = stash_boundaries(4, 32, "gpipe") - stash_boundaries(4, 16, "gpipe")
+    c32 = stash_boundaries(4, 32, "1f1b") - stash_boundaries(4, 16, "1f1b")
+    assert c32 < g32
+    assert pipeline_temp_bytes(2, 8, 2, 128, 64) == stash_boundaries(
+        2, 8, "1f1b"
+    ) * (2 * 128 * 64 * 4)
+    with pytest.raises(ValueError):
+        stash_boundaries(2, 8, "zigzag")
+
+
+def test_pipeline_estimator_tracks_measured_row(devices8):
+    """One live cross-check against XLA's compiled accounting (the
+    pipe_memory tool's smallest leg): prediction within 2x — the
+    estimator is a capacity-planning law, not a byte-exact oracle."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import pipe_memory
+
+    try:
+        t = pipe_memory.measure(2, 4, "full", mb=2, S=128, D=64,
+                                tick_chunk=auto_chunk(2, 4))
+    except NotImplementedError as e:  # legacy-jax partial-manual refusal
+        pytest.skip(str(e).splitlines()[0])
+    pred = pipeline_temp_bytes(2, 4, 2, 128, 64, policy="1f1b",
+                               tick_chunk=auto_chunk(2, 4))
+    assert 0.5 <= pred / t <= 2.0, (pred, t)

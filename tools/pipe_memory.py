@@ -1,5 +1,12 @@
 """Pipeline-schedule activation-memory measurement (VERDICT r4 item #6).
 
+Thin CLI over ``deepspeed_tpu.analysis.cost.pipeline`` — the estimator
+(auto_chunk, boundary bytes, per-policy stash growth laws) lives there
+now, shared with the shardplan cost planner; this tool *measures* the
+same quantity with XLA's own accounting and prints both columns, so
+drift between the analytic law and the compiled buffer assignment is
+visible the day it appears.
+
 The reference's 1F1B schedule (deepspeed/runtime/pipe/engine.py) bounds
 in-flight activation stashes at pp per stage BY CONSTRUCTION; our
 scan+ppermute schedule (runtime/pipe/schedule.py) relies on jax.grad of
@@ -25,24 +32,34 @@ import jax
 
 # a CPU-mesh measurement by design: the container's sitecustomize imports
 # jax under JAX_PLATFORMS=axon before any script line runs, so env vars
-# are too late — force the config flags (same recipe as tests/conftest.py)
+# are too late — force the config flags (same recipe as tests/conftest.py).
+# Older jax has no jax_num_cpu_devices option: fall back to XLA_FLAGS,
+# which still applies when the backend has not initialized yet (and is a
+# no-op when an 8-device backend already exists, e.g. under pytest).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except (AttributeError, ValueError):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        )
 
 import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from deepspeed_tpu.analysis.cost.pipeline import (
+    auto_chunk,
+    boundary_bytes,
+    growth_per_microbatch,
+    pipeline_temp_bytes,
+)
 from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
 from deepspeed_tpu.models import gpt2
 from deepspeed_tpu.runtime.pipe import pipelined_stack
-
-
-def auto_chunk(pp: int, M: int) -> int:
-    """The 1f1b default chunk (mirrors PipelineModule.pipeline_loss)."""
-    ticks = M + pp - 1
-    return max(pp, int(round((ticks / 2) ** 0.5)))
 
 
 def measure(pp: int, M: int, remat_policy, mb=2, S=128, D=64, L=None,
@@ -71,33 +88,36 @@ def measure(pp: int, M: int, remat_policy, mb=2, S=128, D=64, L=None,
 
 def main():
     mb, S, D = 2, 128, 64
-    act_bytes = mb * S * D * 4  # one fp32 boundary activation
+    act_bytes = boundary_bytes(mb, S, D)  # one fp32 boundary activation
     rows = []
-    # legs: (remat policy, chunked?) — "full+1f1b" is what the engine runs
-    # by default at pp>1; "full" alone is the gpipe schedule
-    legs = ((None, False, "none"), ("full", False, "full/gpipe"),
-            ("full", True, "full/1f1b"))
+    # legs: (remat policy, chunked?, estimator policy key) — "full+1f1b" is
+    # what the engine runs by default at pp>1; "full" alone is gpipe
+    legs = ((None, False, "none"), ("full", False, "gpipe"),
+            ("full", True, "1f1b"))
     for pp in (2, 4):
-        for policy, chunked, label in legs:
+        for policy, chunked, law in legs:
             for M in (2, 4, 8, 16, 32):
                 tc = auto_chunk(pp, M) if chunked else None
                 t = measure(pp, M, policy, mb=mb, S=S, D=D, tick_chunk=tc)
-                rows.append({"pp": pp, "policy": label, "M": M,
-                             "tick_chunk": tc, "temp_bytes": t})
-                print(f"pp={pp} policy={label:10s} M={M:3d} "
+                pred = pipeline_temp_bytes(pp, M, mb, S, D, policy=law,
+                                           tick_chunk=tc)
+                rows.append({"pp": pp, "policy": law, "M": M,
+                             "tick_chunk": tc, "temp_bytes": t,
+                             "predicted_bytes": int(pred)})
+                print(f"pp={pp} policy={law:6s} M={M:3d} "
                       f"chunk={tc or '-':>2} temp={t/1e6:8.2f} MB "
-                      f"(= {t/act_bytes:6.1f} boundary activations)",
+                      f"(= {t/act_bytes:6.1f} boundary activations, "
+                      f"est {pred/act_bytes:6.1f})",
                       flush=True)
     # per-(pp,policy) growth: bytes added per extra microbatch, in units of
     # one boundary activation — the scan schedule's stash rate
     print()
     for pp in (2, 4):
-        for _, _, label in legs:
+        for _, _, law in legs:
             pts = [(r["M"], r["temp_bytes"]) for r in rows
-                   if r["pp"] == pp and r["policy"] == label]
-            (m0, t0), (m1, t1) = pts[0], pts[-1]
-            slope = (t1 - t0) / (m1 - m0) / act_bytes
-            print(f"pp={pp} policy={label:10s}: "
+                   if r["pp"] == pp and r["policy"] == law]
+            slope = growth_per_microbatch(pts, act_bytes)
+            print(f"pp={pp} policy={law:6s}: "
                   f"+{slope:.2f} boundary-activations per microbatch")
     out = {"mb": mb, "seq": S, "hidden": D, "act_bytes": act_bytes,
            "rows": rows}

@@ -4,14 +4,21 @@
     python tools/shardlint.py examples/ds_config_zero3.json
     python tools/shardlint.py --all-examples --json /tmp/shardlint.json
     python tools/shardlint.py cfg.json --rules R2,R3
+    python tools/shardlint.py --all-examples --report [--hbm-gb 16]
 
 Each config builds an *abstract* engine (abstract_init — state is
 ShapeDtypeStructs, nothing materializes), traces the jitted train step to
-a jaxpr on a CPU mesh, and runs the R1–R5 rule registry
+a jaxpr on a CPU mesh, and runs the R1–R8 rule registry
 (docs/shardlint.md). Exit code 1 on any error-severity finding — wire
 ``--all-examples`` into the tier-1 flow as the pre-TPU correctness gate
 (it covers every shipped examples/*.json plus the bench.py 410M and 1.5B
 legs, including the double-buffered offload stream).
+
+``--report`` additionally prints the analysis/cost planner table per
+config (docs/memory_planner.md); ``--hbm-gb N`` arms rule R6 so a
+config whose estimated peak exceeds the budget exits 1 before anything
+compiles. ``tools/shardplan.py`` is the planner-first spelling of the
+same flow.
 """
 
 import argparse
@@ -75,28 +82,19 @@ def iter_targets(args):
             yield name, model, cfg
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="shardlint", description=__doc__,
-        formatter_class=argparse.RawDescriptionHelpFormatter,
-    )
-    ap.add_argument("configs", nargs="*", help="ds_config.json paths")
-    ap.add_argument("--all-examples", action="store_true",
-                    help="lint every shipped examples/*.json plus the "
-                         "bench.py 410M/1.5B legs")
-    ap.add_argument("--json", metavar="PATH",
-                    help="write the machine-readable report here "
-                         "('-' for stdout)")
-    ap.add_argument("--rules", metavar="IDS",
-                    help="comma-separated rule subset (e.g. R2,R3)")
-    args = ap.parse_args(argv)
-    if not args.configs and not args.all_examples:
-        ap.error("no targets: pass config paths and/or --all-examples")
-
+def run_lint(args, collect_plan=False):
+    """One definition of the per-target lint loop (shardplan delegates
+    here): normalize the shared --rules/--hbm-gb flags, build each
+    target's abstract engine, lint it, aggregate into a Report;
+    NotImplementedError targets (legacy-jax partial-manual shard_map
+    legs etc.) are recorded as skipped, not silently passed."""
     only = (
         [r.strip().upper() for r in args.rules.split(",") if r.strip()]
         if args.rules
         else None
+    )
+    budget = (
+        args.hbm_gb * (1 << 30) if args.hbm_gb is not None else None
     )
 
     import deepspeed_tpu.comm as comm
@@ -111,15 +109,46 @@ def main(argv=None) -> int:
             cfg = DeepSpeedConfig(cfg_dict)
             if model is None:
                 model = default_model_for(cfg)
-            sub = lint_config(cfg_dict, model=model, source=name, only=only)
+            sub = lint_config(
+                cfg_dict, model=model, source=name, only=only,
+                hbm_budget_bytes=budget, collect_plan=collect_plan,
+            )
             report.extend(sub.findings)
             report.sources.extend(sub.sources)
+            report.plans.extend(sub.plans)
         except NotImplementedError as e:
-            # legacy-jax partial-manual shard_map legs etc. — skipped, not
-            # silently passed
             report.add_source(name, time.time() - t0, 0,
                               skipped=str(e).splitlines()[0][:120])
+    return report
 
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shardlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("configs", nargs="*", help="ds_config.json paths")
+    ap.add_argument("--all-examples", action="store_true",
+                    help="lint every shipped examples/*.json plus the "
+                         "bench.py 410M/1.5B legs")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable report here "
+                         "('-' for stdout)")
+    ap.add_argument("--rules", metavar="IDS",
+                    help="comma-separated rule subset (e.g. R2,R3)")
+    ap.add_argument("--report", action="store_true",
+                    help="print the cost-planner table per config "
+                         "(params / opt / activations / peak GiB, ICI "
+                         "GiB/step, est. step_s — analysis/cost)")
+    ap.add_argument("--hbm-gb", type=float, metavar="N",
+                    help="per-device HBM budget in GiB; arms rule R6 "
+                         "(exit 1 when a config's estimated peak exceeds "
+                         "it)")
+    args = ap.parse_args(argv)
+    if not args.configs and not args.all_examples:
+        ap.error("no targets: pass config paths and/or --all-examples")
+
+    report = run_lint(args, collect_plan=args.report)
     print(report.format())
     if args.json:
         payload = report.to_json(indent=2)
