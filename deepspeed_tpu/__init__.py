@@ -29,3 +29,12 @@ def init_inference(*args, **kwargs):
     from .inference.engine import init_inference as _init_inference
 
     return _init_inference(*args, **kwargs)
+
+
+def init_serving(model=None, serving=None, **kwargs):
+    """Continuous-batching serving front door (DeepSpeed-MII / FastGen
+    parity): model + "serving" config section → :class:`ServingEngine`
+    (request queue + SplitFuse scheduler + ONE jitted slot step)."""
+    from .serving import ServingEngine
+
+    return ServingEngine(model=model, serving=serving, **kwargs)

@@ -39,7 +39,8 @@ from .cost import (
     plan_jaxpr,
 )
 from .rules import register_rule, registered_rules
-from .shardlint import lint_config, lint_engine, lint_jaxpr
+from .shardlint import (lint_config, lint_engine, lint_jaxpr,
+                        lint_serving_config)
 
 __all__ = [
     "Finding",
@@ -51,6 +52,7 @@ __all__ = [
     "lint_config",
     "lint_engine",
     "lint_jaxpr",
+    "lint_serving_config",
     "plan_config",
     "plan_engine",
     "plan_jaxpr",

@@ -46,9 +46,6 @@ def overlap_budget(ctx: LintContext) -> List[Finding]:
 
     plan = plan_for_context(ctx)
     hw = plan.hardware
-    # the compute window one step provides: the roofline's non-stream
-    # terms (MXU flops and HBM traffic — the work the stream hides under)
-    window_s = max(plan.compute_s, plan.hbm_s)
     findings: List[Finding] = []
     for name, s in streams.items():
         nbytes = float(
@@ -58,8 +55,22 @@ def overlap_budget(ctx: LintContext) -> List[Finding]:
         if nbytes <= 0:
             continue
         kind = s.get("kind", "offload")
-        bw = hw.host_bw if kind == "offload" else hw.ici_bw
+        if kind == "offload":
+            bw = hw.host_bw
+        elif kind == "hbm":  # serving KV-arena stream
+            bw = hw.hbm_bw
+        else:
+            bw = hw.ici_bw
         stream_s = nbytes / bw if bw > 0 else 0.0
+        # the window one step provides THIS stream: host-DMA and ICI
+        # streams hide under the larger of the MXU and HBM roofline
+        # terms, but an HBM stream shares the very link that produces
+        # hbm_s — it can only hide under the MXU term, else it simply
+        # extends the HBM-bound step
+        window_s = (
+            plan.compute_s if kind == "hbm"
+            else max(plan.compute_s, plan.hbm_s)
+        )
         if stream_s <= window_s or stream_s - window_s < _MIN_EXPOSED_S:
             continue
         findings.append(Finding(
@@ -68,7 +79,8 @@ def overlap_budget(ctx: LintContext) -> List[Finding]:
             message=(
                 f"stream '{name}' is declared overlapped but its "
                 f"{nbytes / _GIB:.2f} GiB/step over the "
-                f"{'host DMA' if kind == 'offload' else 'ICI'} link "
+                f"{ {'offload': 'host DMA', 'hbm': 'HBM'}.get(kind, 'ICI') }"
+                " link "
                 f"({bw / 1e9:.0f} GB/s) needs {stream_s:.4f}s — more than "
                 f"the {window_s:.4f}s compute window the step provides "
                 f"(MXU {plan.compute_s:.4f}s, HBM {plan.hbm_s:.4f}s); the "

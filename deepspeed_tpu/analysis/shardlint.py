@@ -278,6 +278,61 @@ def lint_engine(engine, only: Optional[Sequence[str]] = None,
     return report
 
 
+def lint_serving_config(config, model=None, topology=None,
+                        only: Optional[Sequence[str]] = None,
+                        source: Optional[str] = None,
+                        hbm_budget_bytes: Optional[float] = None,
+                        hardware=None,
+                        collect_plan: bool = False) -> Report:
+    """Lint a SERVING config: trace the continuous-batching engine's one
+    jitted slot step abstractly (serving.trace_serving_step — params and
+    the KV arena are ShapeDtypeStructs with real shardings) and run the
+    same R1–R8 registry over it. The declared analytic streams (the
+    per-step KV-arena traffic) feed the planner and rule R8 exactly like
+    the training engines' streams."""
+    from ..config import DeepSpeedConfig
+    from ..comm.topology import MeshTopology, ParallelDims
+    from ..serving.engine import trace_serving_step
+    from .cost import plan_for_context
+    from .rules import run_rules
+
+    if model is None:
+        raise ValueError("lint_serving_config requires a model (the step "
+                         "program is model-shaped)")
+    ds = (
+        config if isinstance(config, DeepSpeedConfig)
+        else DeepSpeedConfig(config)
+    )
+    tp = max(int(ds.tensor_parallel.tp_size), 1)
+    if topology is None:
+        topology = MeshTopology(
+            dims=ParallelDims(tp=tp), devices=jax.devices()[:tp]
+        )
+    report = Report()
+    name = source or "serving"
+    t0 = time.time()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        closed, arg_shardings, streams = trace_serving_step(
+            model, ds, topology
+        )
+    ctx = LintContext(
+        closed_jaxpr=closed,
+        mesh=topology.mesh,
+        arg_shardings=arg_shardings,
+        source=name,
+        hbm_budget_bytes=hbm_budget_bytes,
+        streams=streams,
+        hardware=hardware,
+    )
+    findings = run_rules(ctx, only=only)
+    report.extend(findings)
+    report.add_source(name, time.time() - t0, len(findings))
+    if collect_plan:
+        report.plans.append(plan_for_context(ctx))
+    return report
+
+
 def lint_config(config, model=None, topology=None,
                 only: Optional[Sequence[str]] = None,
                 source: Optional[str] = None,
@@ -289,13 +344,26 @@ def lint_config(config, model=None, topology=None,
     ``config`` is anything DeepSpeedConfig accepts (dict / path). The
     caller owns comm state: an already-initialized topology is reused,
     else one is built from the config exactly like training would.
+    Configs whose "serving" section is enabled lint the serving engine's
+    slot step instead of a train step (:func:`lint_serving_config`).
     """
     import deepspeed_tpu
+    from ..config import DeepSpeedConfig
 
     if model is None:
         raise ValueError("lint_config requires a model (the step program "
                          "is model-shaped); tools/shardlint.py picks one "
                          "from the config when run as a CLI")
+    ds = (
+        config if isinstance(config, DeepSpeedConfig)
+        else DeepSpeedConfig(config)
+    )
+    if ds.serving.enabled:
+        return lint_serving_config(
+            ds, model=model, topology=topology, only=only, source=source,
+            hbm_budget_bytes=hbm_budget_bytes, hardware=hardware,
+            collect_plan=collect_plan,
+        )
     engine, *_ = deepspeed_tpu.initialize(
         model=model, config=config, topology=topology, abstract_init=True
     )

@@ -242,6 +242,51 @@ class TensorParallelConfig:
 
 
 @dataclass
+class ServingConfig:
+    """"serving" section — the continuous-batching runtime
+    (deepspeed_tpu/serving/). Parity: DeepSpeed-MII / FastGen's
+    continuous batching + Dynamic SplitFuse scheduling, TPU-native: one
+    jitted step of fixed shape [max_slots, token_budget] serves arbitrary
+    arrival patterns with zero recompiles after warmup."""
+
+    enabled: bool = False
+    max_slots: int = 8           # concurrent in-flight requests (KV slots)
+    token_budget: int = 64       # tokens processed per engine step (the
+                                 # SplitFuse chunk width; prompts longer
+                                 # than this prefill across steps)
+    queue_limit: int = 64        # bounded admission queue; 0 = unbounded
+    request_timeout_s: float = 60.0   # queued longer than this → EVICTED
+    eviction_backoff_s: float = 1.0   # retry-after hint: backoff * 2**attempts
+    max_tokens: int = 1024       # per-request prompt+output cap (slot KV
+                                 # capacity; clamped to model max_seq_len)
+    kv_cache_dtype: str = "auto"  # auto | bf16 | bfloat16 | int8
+
+    def validate(self) -> None:
+        if int(self.max_slots) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.max_slots must be >= 1, got {self.max_slots}"
+            )
+        if int(self.token_budget) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.token_budget must be >= 1, got {self.token_budget}"
+            )
+        if int(self.queue_limit) < 0:
+            raise DeepSpeedConfigError(
+                f"serving.queue_limit must be >= 0, got {self.queue_limit}"
+            )
+        if float(self.request_timeout_s) <= 0:
+            raise DeepSpeedConfigError(
+                "serving.request_timeout_s must be > 0, got "
+                f"{self.request_timeout_s}"
+            )
+        if self.kv_cache_dtype not in ("auto", "int8", "bf16", "bfloat16"):
+            raise DeepSpeedConfigError(
+                "serving.kv_cache_dtype must be auto|bf16|bfloat16|int8, "
+                f"got {self.kv_cache_dtype!r}"
+            )
+
+
+@dataclass
 class FlopsProfilerConfig:
     enabled: bool = False
     profile_step: int = 1
@@ -501,6 +546,7 @@ class DeepSpeedConfig:
             oc = {"enabled": oc}
         tp["overlap_comm"] = _parse_dc(OverlapCommConfig, oc)
         self.tensor_parallel = _parse_dc(TensorParallelConfig, tp)
+        self.serving = _parse_dc(ServingConfig, d.get("serving"))
         sp = d.get("sequence_parallel") or {}
         if "sequence_parallel_size" in d:
             sp.setdefault("sp_size", d["sequence_parallel_size"])
@@ -603,6 +649,7 @@ class DeepSpeedConfig:
                 "pp stage boundaries)"
             )
         self.tensor_parallel.overlap_comm.validate()
+        self.serving.validate()
         if (
             self.tensor_parallel.overlap_comm.enabled
             and self.pipeline.stages > 1

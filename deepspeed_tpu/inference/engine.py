@@ -47,11 +47,27 @@ def _align_cache(n: int, mult: int = 128) -> int:
     return max(-(-n // mult) * mult, mult)
 
 
-def apply_repetition_penalty(logits, seen, penalty):
+def _bucket_prompt(n: int, mult: int = 32) -> int:
+    """Prompt-width bucket for the compile cache. The KV cache itself
+    keeps the 128 alignment (_align_cache — the Pallas block contract);
+    the PREFILL WIDTH has no such constraint, so a finer granule wastes
+    less padded prefill compute on short prompts while still collapsing
+    the ragged-length neighborhood onto a handful of programs."""
+    return _align_cache(n, mult)
+
+
+def apply_repetition_penalty(logits, seen, penalty, active=None):
     """HF-convention repetition penalty: for tokens in ``seen`` [B, V],
-    positive logits divide by the penalty, negative multiply."""
+    positive logits divide by the penalty, negative multiply.
+
+    ``active`` ([B] or [B, 1] bool, optional) masks ragged-batch rows:
+    padded/inactive slots keep their logits untouched instead of
+    attending whatever stale ``seen`` garbage their row holds."""
     penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
-    return jnp.where(seen, penalized, logits)
+    mask = seen
+    if active is not None:
+        mask = mask & jnp.reshape(active, (-1, 1))
+    return jnp.where(mask, penalized, logits)
 
 
 def init_inference(
@@ -313,7 +329,13 @@ class InferenceEngine:
                     jax.random.PRNGKey(1), dtype=dtype
                 )
             self.draft_params = jax.tree.map(cast, draft_params)
-        self._decode_fns: Dict[int, Any] = {}
+        self._decode_fns: Dict[Any, Any] = {}
+        # recompile observability (serving warmup): programs are keyed on
+        # bucketed (B, prompt, total) shapes (prompt at 32, total at the
+        # cache's 128), so this counts one compile per shape bucket — a
+        # replayed ragged trace stays flat after warmup instead of
+        # growing per exact length
+        self.num_compiles = 0
         n_params = sum(
             int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
         )
@@ -424,7 +446,8 @@ class InferenceEngine:
     __call__ = forward
 
     # -------------------------------------------------- speculative decode
-    def _build_spec_decode(self, prompt_len: int, total_len: int, k: int):
+    def _build_spec_decode(self, prompt_bucket: int, total_bucket: int,
+                           k: int):
         """Greedy speculative decoding, B=1 (the latency-bound serving case).
 
         Reference-era DeepSpeed ships this in its serving stack; TPU-native
@@ -449,12 +472,20 @@ class InferenceEngine:
         since batch-1 decode is HBM-bound, verifying k tokens streams the
         same weight bytes as decoding one, so every accepted draft token
         is nearly free throughput.
+
+        Shapes are BUCKETED (``prompt_bucket`` at 32, ``total_bucket`` at
+        the cache's 128); the actual ``prompt_len``/``total_len`` ride as
+        traced operands, so every request whose lengths round to the same
+        buckets reuses one compiled program. Padding beyond the real prompt holds the eos
+        fill; its cache writes sit beyond the frontier and are rewritten
+        before any query can attend them.
         """
         cfg = self.config
         ngram = isinstance(self.draft_model, str)
         m = int(self.spec_ngram_n)
         dcfg = None if ngram else self.draft_model.config
-        total_alloc = total_len + k  # margin so last-round writes stay in-bounds
+        # margin so last-round writes stay in-bounds
+        total_alloc = total_bucket + k
 
         def ngram_propose(tokens_buf, pos):
             """[1, k-1] proposed tokens for positions pos+1..pos+k-1."""
@@ -473,7 +504,8 @@ class InferenceEngine:
             cont = lax.dynamic_slice(buf, (start,), (k - 1,))
             return cont[None, :].astype(jnp.int32)
 
-        def spec_generate(params, dparams, tokens_buf, eos_id):
+        def spec_generate(params, dparams, tokens_buf, prompt_len, total_len,
+                          eos_id):
             main_cache = init_cache(
                 cfg, 1, _align_cache(total_alloc),
                 self.kv_cache_storage_dtype,
@@ -483,12 +515,14 @@ class InferenceEngine:
                 jnp.zeros((), jnp.int32) if ngram
                 else init_cache(dcfg, 1, _align_cache(total_alloc), self.dtype)
             )
-            prompt = tokens_buf[:, :prompt_len]
+            prompt = tokens_buf[:, :prompt_bucket]
             logits, main_cache = forward_with_cache(
                 cfg, params, prompt,
                 main_cache, 0, dtype=self.dtype
             )
-            n0 = jnp.argmax(logits[:, -1], axis=-1)  # token at position P
+            # last REAL prompt position (the bucket tail is padding)
+            last = lax.dynamic_slice_in_dim(logits, prompt_len - 1, 1, 1)
+            n0 = jnp.argmax(last[:, 0], axis=-1)  # token at position P
             tokens_buf = lax.dynamic_update_slice(
                 tokens_buf, n0[:, None], (0, prompt_len)
             )
@@ -582,26 +616,35 @@ class InferenceEngine:
             idx = jnp.arange(total_alloc)[None, :]
             tokens_buf = jnp.where(idx <= pos, tokens_buf, fill)
             # rounds = verifier forwards: acceptance observability (a perfect
-            # draft needs ceil((new_tokens-1)/k) rounds)
-            return tokens_buf[:, :total_len], rounds
+            # draft needs ceil((new_tokens-1)/k) rounds). The caller trims
+            # the bucketed buffer to the real total_len.
+            return tokens_buf, rounds
 
         return jax.jit(spec_generate)
 
     # ------------------------------------------------------------- generate
-    def _build_decode(self, B: int, prompt_len: int, total_len: int):
+    def _build_decode(self, B: int, prompt_bucket: int, total_bucket: int):
+        """One decode program per BUCKETED (B, prompt, total) shape
+        (prompt at 32, total at the cache's 128): the exact
+        ``prompt_len``/``total_len`` are traced operands, so the whole
+        ragged-length neighborhood shares a compile (the serving warmup
+        stops scaling with distinct request lengths)."""
         cfg = self.config
 
-        def prefill(params, tokens_buf):
+        def prefill(params, tokens_buf, prompt_len):
             cache = init_cache(
-                cfg, B, _align_cache(total_len), self.kv_cache_storage_dtype,
+                cfg, B, _align_cache(total_bucket),
+                self.kv_cache_storage_dtype,
                 quantized=self.kv_cache_quantized,
             )
-            prompt = tokens_buf[:, :prompt_len]
+            prompt = tokens_buf[:, :prompt_bucket]
             logits, cache = forward_with_cache(
                 cfg, params, prompt, cache,
                 0, dtype=self.dtype
             )
-            return logits[:, -1], cache
+            # last REAL prompt position (the bucket tail is eos padding)
+            last = lax.dynamic_slice_in_dim(logits, prompt_len - 1, 1, 1)
+            return last[:, 0], cache
 
         def sample(logits, key, temperature, top_k, top_p):
             logits = logits / jnp.maximum(temperature, 1e-6)
@@ -624,28 +667,31 @@ class InferenceEngine:
             sampled = jax.random.categorical(key, logits, axis=-1)
             return jnp.where(temperature == 0.0, greedy, sampled)
 
-        def generate(params, tokens_buf, rng, temperature, top_k, top_p,
-                     rep_penalty, use_penalty, eos_id):
+        def generate(params, tokens_buf, prompt_len, total_len, rng,
+                     temperature, top_k, top_p, rep_penalty, use_penalty,
+                     eos_id):
             V = cfg.vocab_size
             rows = jnp.arange(B)
 
-            def step_sample(logits, seen, key):
+            def step_sample(logits, seen, key, live=None):
                 if use_penalty:
-                    logits = apply_repetition_penalty(logits, seen, rep_penalty)
+                    logits = apply_repetition_penalty(
+                        logits, seen, rep_penalty, active=live
+                    )
                 return sample(logits, key, temperature, top_k, top_p)
 
             # seen-token mask carried through the loop: built once from the
             # prompt, then one O(B) scatter per generated token (not a full
             # (B,V) rebuild per step)
             if use_penalty:
-                prompt_live = jnp.arange(total_len)[None, :] < prompt_len
+                prompt_live = jnp.arange(total_bucket)[None, :] < prompt_len
                 seen = jnp.zeros((B, V), jnp.bool_).at[
                     rows[:, None], tokens_buf
                 ].max(prompt_live)
             else:
                 seen = jnp.zeros((B, 1), jnp.bool_)  # unused placeholder
 
-            last_logits, cache = prefill(params, tokens_buf)
+            last_logits, cache = prefill(params, tokens_buf, prompt_len)
             key, rng = jax.random.split(rng)
             nxt = step_sample(last_logits, seen, key)
             if use_penalty:
@@ -669,10 +715,13 @@ class InferenceEngine:
                     tok, cache, pos, dtype=self.dtype
                 )
                 key, rng = jax.random.split(rng)
-                nxt = step_sample(logits[:, -1], seen, key)
+                nxt = step_sample(logits[:, -1], seen, key, live=~done)
                 nxt = jnp.where(done, jnp.full_like(nxt, eos_id), nxt)
                 if use_penalty:
-                    seen = seen.at[rows, nxt].set(True)
+                    # ragged-batch hazard fix: rows already done emit
+                    # forced eos padding — never book it as "seen" (and
+                    # never scatter a negative eos sentinel)
+                    seen = seen.at[rows, jnp.clip(nxt, 0, V - 1)].max(~done)
                 tokens_buf = lax.dynamic_update_slice(
                     tokens_buf, nxt[:, None], (0, pos + 1)
                 )
@@ -686,8 +735,9 @@ class InferenceEngine:
             return tokens_buf
 
         # top_k/top_p/use_penalty static (each gates a sort/scatter); the
-        # penalty VALUE stays traced so sweeping it doesn't recompile
-        return jax.jit(generate, static_argnums=(4, 5, 7))
+        # penalty VALUE and the real lengths stay traced so sweeping them
+        # doesn't recompile
+        return jax.jit(generate, static_argnums=(6, 7, 9))
 
     def generate(
         self,
@@ -723,6 +773,11 @@ class InferenceEngine:
                 f"max_tokens"
             )
         total_len = min(prompt_len + max_new_tokens, self.max_tokens)
+        # bucketed program shapes (prompt at 32, total at the cache's 128):
+        # the exact lengths ride as traced operands, so a ragged arrival
+        # trace compiles once per bucket
+        pb, tb = _bucket_prompt(prompt_len), _align_cache(total_len)
+        fill = eos_token_id if eos_token_id >= 0 else 0
         speculative = (
             self.draft_model is not None
             and temperature == 0.0
@@ -732,39 +787,48 @@ class InferenceEngine:
         )
         if speculative:
             k = int(num_draft_tokens) + 1  # window = drafts + bonus slot
-            key = ("spec", prompt_len, total_len, k)
+            key = ("spec", pb, tb, k)
             if key not in self._decode_fns:
-                self._decode_fns[key] = self._build_spec_decode(
-                    prompt_len, total_len, k
+                self.num_compiles += 1
+                log_dist(
+                    f"inference compile #{self.num_compiles}: spec decode "
+                    f"bucket (prompt<={pb}, total<={tb}, k={k})"
                 )
-            buf = np.full(
-                (1, total_len + k),
-                eos_token_id if eos_token_id >= 0 else 0, dtype=np.int32,
-            )
+                self._decode_fns[key] = self._build_spec_decode(pb, tb, k)
+            buf = np.full((1, tb + k), fill, dtype=np.int32)
             buf[:, :prompt_len] = ids
             with use_topology(self.topology), self._impl_ctx():
                 out, rounds = self._decode_fns[key](
                     self.params, self.draft_params, jnp.asarray(buf),
-                    eos_token_id,
+                    prompt_len, total_len, eos_token_id,
                 )
             self.last_spec_rounds = int(rounds)  # verifier calls this generate
-            return np.asarray(out)
-        key = (B, prompt_len, total_len)
+            return np.asarray(out)[:, :total_len]
+        statics = (top_k, float(top_p), float(repetition_penalty) != 1.0)
+        key = (B, pb, tb) + statics
         if key not in self._decode_fns:
-            self._decode_fns[key] = self._build_decode(B, prompt_len, total_len)
-        buf = np.full((B, total_len), eos_token_id if eos_token_id >= 0 else 0,
-                      dtype=np.int32)
+            self.num_compiles += 1
+            log_dist(
+                f"inference compile #{self.num_compiles}: decode bucket "
+                f"(B={B}, prompt<={pb}, total<={tb}, "
+                f"top_k={statics[0]}, top_p={statics[1]}, "
+                f"penalty={statics[2]})"
+            )
+            self._decode_fns[key] = self._build_decode(B, pb, tb)
+        buf = np.full((B, tb), fill, dtype=np.int32)
         buf[:, :prompt_len] = ids
         with use_topology(self.topology), self._impl_ctx():
             out = self._decode_fns[key](
                 self.params,
                 jnp.asarray(buf),
+                prompt_len,
+                total_len,
                 rng if rng is not None else jax.random.PRNGKey(0),
                 jnp.asarray(temperature, jnp.float32),
-                top_k,
-                float(top_p),
+                statics[0],
+                statics[1],
                 jnp.asarray(repetition_penalty, jnp.float32),
-                float(repetition_penalty) != 1.0,
+                statics[2],
                 eos_token_id,
             )
-        return np.asarray(out)
+        return np.asarray(out)[:, :total_len]

@@ -35,6 +35,34 @@ Cache = Dict[str, jax.Array]
 SCALE_LANES = 8  # redundant scale copies (min sublane tile; kernels read col 0)
 
 
+def _is_ragged(cache_len) -> bool:
+    """True when ``cache_len`` is a per-row [B] vector (the serving
+    engine's slot batch), False for the classic shared scalar."""
+    return getattr(cache_len, "ndim", 0) == 1
+
+
+def _update_at(cache: jax.Array, new: jax.Array, cache_len) -> jax.Array:
+    """Write ``new`` [B, S, KV, hd] into ``cache`` [B, Smax, KV, hd] at
+    per-batch offset ``cache_len`` (scalar or [B] vector). The vector form
+    is a vmapped per-row dynamic_update_slice — each slot of a ragged
+    serving batch advances its own write frontier."""
+    if _is_ragged(cache_len):
+        return jax.vmap(
+            lambda c, u, off: lax.dynamic_update_slice(c, u, (off, 0, 0))
+        )(cache, new, cache_len)
+    return lax.dynamic_update_slice(cache, new, (0, cache_len, 0, 0))
+
+
+def _update_scale_at(scale: jax.Array, new: jax.Array, cache_len) -> jax.Array:
+    """Scale-cache twin of :func:`_update_at`: ``scale`` is stored
+    pre-transposed as [B, KV, Smax, SL]; ``new`` arrives [B, KV, S, SL]."""
+    if _is_ragged(cache_len):
+        return jax.vmap(
+            lambda c, u, off: lax.dynamic_update_slice(c, u, (0, off, 0))
+        )(scale, new, cache_len)
+    return lax.dynamic_update_slice(scale, new, (0, 0, cache_len, 0))
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=jnp.bfloat16, quantized: bool = False) -> Cache:
     """Static KV ring buffer for all layers.
@@ -112,6 +140,14 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     cache_len=pos). int8 caches carry per-(token, head) scales; the fresh
     prefill attends with the exact (unquantized) new k/v — only reads from
     the cache dequantize.
+
+    ``cache_len`` may be a per-row [B] vector (the serving engine's ragged
+    slot batch): every row then writes and masks at its own frontier.
+    Query positions past a row's real token count produce garbage outputs
+    and garbage cache entries BEYOND that row's frontier — both are
+    harmless by the frontier invariant (a later query only attends
+    kpos <= its own position, and every position is rewritten by its real
+    token before any query can reach it).
     """
     B, S, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
@@ -122,23 +158,15 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     if quantized:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
-        k_cache = lax.dynamic_update_slice(k_cache, kq, (0, cache_len, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, vq, (0, cache_len, 0, 0))
+        k_cache = _update_at(k_cache, kq, cache_len)
+        v_cache = _update_at(v_cache, vq, cache_len)
         # new-token scales transpose into the [B, KV, S, SL] cache layout —
         # tiny ([B,S,KV,SL]); the big int8 value caches never relayout
-        k_scale = lax.dynamic_update_slice(
-            k_scale, jnp.swapaxes(ks, 1, 2), (0, 0, cache_len, 0)
-        )
-        v_scale = lax.dynamic_update_slice(
-            v_scale, jnp.swapaxes(vs, 1, 2), (0, 0, cache_len, 0)
-        )
+        k_scale = _update_scale_at(k_scale, jnp.swapaxes(ks, 1, 2), cache_len)
+        v_scale = _update_scale_at(v_scale, jnp.swapaxes(vs, 1, 2), cache_len)
     else:
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
-        )
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
-        )
+        k_cache = _update_at(k_cache, k.astype(k_cache.dtype), cache_len)
+        v_cache = _update_at(v_cache, v.astype(v_cache.dtype), cache_len)
 
     def ret(out):
         if quantized:
@@ -197,7 +225,11 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kf) * scale
     kpos = jnp.arange(S_max)[None, None, None, :]
-    qpos = (cache_len + jnp.arange(S))[None, None, :, None]
+    # [B or 1, 1, S, 1]: each row masks at its own frontier when cache_len
+    # is the serving engine's per-slot vector
+    qpos = jnp.asarray(cache_len).reshape(-1, 1, 1, 1) + (
+        jnp.arange(S)[None, None, :, None]
+    )
     if cfg.pos_embedding == "alibi":
         slopes = jnp.asarray(alibi_slopes(nh))
         logits = logits + slopes[None, :, None, None] * (
@@ -219,13 +251,22 @@ def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Ar
     """Run new tokens through all layers against the cache.
 
     input_ids: [B, S] (prefill) or [B, 1] (decode). cache_len: tokens already
-    cached. Returns (fp32 logits [B, S, V], updated cache).
+    cached — a shared scalar, or a per-row [B] vector for the serving
+    engine's ragged slot batch. Returns (fp32 logits [B, S, V], updated
+    cache).
     """
     B, S = input_ids.shape
     from ..ops.quantizer import cast_floating
 
     cast = lambda t: cast_floating(t, dtype)
-    positions = cache_len + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    if _is_ragged(cache_len):
+        positions = cache_len[:, None].astype(jnp.int32) + jnp.arange(
+            S, dtype=jnp.int32
+        )[None, :]
+    else:
+        positions = cache_len + jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32), (B, S)
+        )
     x = cast(params["embed"]["tok"])[input_ids]
     if cfg.pos_embedding == "learned":
         x = x + cast(params["embed"]["pos"])[positions]

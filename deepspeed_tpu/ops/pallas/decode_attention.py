@@ -41,7 +41,9 @@ def _decode_kernel(*refs, scale, block_s, has_scales=False):
         ks_ref = vs_ref = None
     si = pl.program_id(2)
     ns = pl.num_programs(2)
-    cl = cl_ref[0, 0]  # new token's position == number of cached tokens
+    # this batch row's new-token position == its cached-token count (the
+    # cl operand is per-row [B, 1]; the grid's b axis picks the row)
+    cl = cl_ref[0, 0]
 
     @pl.when(si == 0)
     def _init():
@@ -105,10 +107,11 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
                             interpret: Optional[bool] = None):
     """q [B,1,H,hd] new-token queries vs k/v_cache [B,Smax,KV,hd].
 
-    cache_len: scalar int32 — the new token's position (tokens already
-    cached). Returns [B,1,H,hd]. Caller guarantees the new token's k/v are
-    already written at ``cache_len``. int8 caches pass per-token scales in
-    the storage layout [B,KV,Smax,SCALE_LANES]; dequant happens on the tile
+    cache_len: int32 scalar — or a per-row [B] vector for ragged serving
+    slot batches — the new token's position (tokens already cached).
+    Returns [B,1,H,hd]. Caller guarantees the new token's k/v are already
+    written at ``cache_len``. int8 caches pass per-token scales in the
+    storage layout [B,KV,Smax,SCALE_LANES]; dequant happens on the tile
     in VMEM.
     """
     B, one, H, hd = q.shape
@@ -120,7 +123,11 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
         interpret = jax.default_backend() != "tpu"
     scale = 1.0 / (hd**0.5)
     qg = q.reshape(B, KV, G, hd)
-    cl = jnp.reshape(cache_len, (1, 1)).astype(jnp.int32)
+    # per-row [B, 1] in SMEM: scalars broadcast so every row predicates
+    # on the same frontier, serving batches bring one frontier per slot
+    cl = jnp.broadcast_to(
+        jnp.asarray(cache_len, jnp.int32).reshape(-1, 1), (B, 1)
+    )
     ns = Smax // bs
     has_scales = k_scale is not None
 
@@ -153,7 +160,7 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
         ]
     operands.append(cl)
     in_specs.append(
-        pl.BlockSpec((1, 1), lambda b, kv, si: (0, 0), memory_space=pltpu.SMEM)
+        pl.BlockSpec((1, 1), lambda b, kv, si: (b, 0), memory_space=pltpu.SMEM)
     )
 
     out = pl.pallas_call(
@@ -240,8 +247,12 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
         # scales are [B, KV, Smax, SCALE_LANES]: head dim 1 follows tp
         operands += [k_scale, v_scale]
         in_specs += [P(b_ax, h_ax, None, None), P(b_ax, h_ax, None, None)]
-    operands.append(jnp.asarray(cache_len, jnp.int32))
-    in_specs.append(P())
+    # the frontier rides as a per-row [B] vector sharded with the batch
+    # (a scalar cache_len broadcasts — every shard sees the same value)
+    operands.append(jnp.broadcast_to(
+        jnp.asarray(cache_len, jnp.int32).reshape(-1), (B,)
+    ))
+    in_specs.append(P(b_ax))
 
     def body(q, kc, vc, *rest):
         if has_scales:

@@ -64,6 +64,11 @@ class CommsLogger:
         # wire bytes here (parallel/tensor_overlap.ring_wire_bytes_per_step)
         self.ring_steps = 0
         self.ring_bytes = 0
+        # serving KV-arena accounting (serving/engine.analytic_streams):
+        # the slot engine's per-step cache read/write is plain HBM
+        # traffic, not a collective — reported analytically per step
+        self.kv_steps = 0
+        self.kv_bytes = 0
         self._t0 = time.time()
         register_comm_hook(self._on_op)
 
@@ -116,6 +121,27 @@ class CommsLogger:
         self.ring_steps += steps
         self.ring_bytes += nbytes_per_step * steps
 
+    # -------------------------------------------------- serving KV stats
+    def record_kv(self, nbytes_per_step: int, steps: int = 1) -> None:
+        """Account ``steps`` serving-engine steps of slot-KV-arena HBM
+        traffic (``nbytes_per_step`` = analytic k+v arena bytes streamed
+        per step; serving/engine.serving_kv_stream)."""
+        self.kv_steps += steps
+        self.kv_bytes += nbytes_per_step * steps
+
+    def kv_summary(self, duration_s: Optional[float] = None) -> str:
+        """One line of serving KV-arena accounting (empty when idle)."""
+        if not self.kv_steps:
+            return ""
+        dur = self.elapsed if duration_s is None else duration_s
+        per_step = self.kv_bytes / self.kv_steps
+        gbps = self.kv_bytes * 8 / dur / 1e9 if dur > 0 else 0.0
+        return (
+            f"serving kv arena: {self.kv_steps} steps, "
+            f"{per_step / 2**20:.2f} MiB/step (k+v stream), "
+            f"{gbps:.2f} Gbps over window"
+        )
+
     # ------------------------------------------------ shared stream intake
     def record_streams(self, streams, steps: int = 1) -> None:
         """ONE analytic-stream accounting path for every hidden-stream
@@ -140,6 +166,9 @@ class CommsLogger:
                 )
             elif kind == "ici":
                 self.record_ring(s.get("bytes_per_step", 0), steps=steps)
+            elif kind == "hbm":
+                # the serving engine's per-step KV-arena stream
+                self.record_kv(s.get("bytes_per_step", 0), steps=steps)
 
     def ring_summary(self, duration_s: Optional[float] = None) -> str:
         """One line of ring-wire accounting (empty when no rings ran)."""
@@ -242,6 +271,9 @@ class CommsLogger:
         ring = self.ring_summary(duration_s=dur)
         if ring:
             lines.append(ring)
+        kv = self.kv_summary(duration_s=dur)
+        if kv:
+            lines.append(kv)
         return "\n".join(lines)
 
     def log_summary(self, axis_sizes: Optional[Dict[str, int]] = None) -> None:

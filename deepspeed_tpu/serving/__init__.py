@@ -1,0 +1,26 @@
+"""Continuous-batching serving runtime (DeepSpeed-MII / FastGen parity).
+
+Request queue + Dynamic-SplitFuse scheduler + a slot-based engine whose
+ONE jitted step of fixed shape ``[max_slots, token_budget]`` serves
+arbitrary arrival patterns with zero recompiles after warmup. See
+docs/serving.md for architecture, scheduler invariants, config keys and
+the metrics glossary.
+"""
+
+from .engine import ServingEngine, make_step_fn, trace_serving_step
+from .metrics import ServingMetrics
+from .request import Request, RequestState, RequestStatus, request_rng
+from .scheduler import Scheduler, StepPlan
+
+__all__ = [
+    "Request",
+    "RequestState",
+    "RequestStatus",
+    "Scheduler",
+    "ServingEngine",
+    "ServingMetrics",
+    "StepPlan",
+    "make_step_fn",
+    "request_rng",
+    "trace_serving_step",
+]

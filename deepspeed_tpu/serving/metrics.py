@@ -1,0 +1,160 @@
+"""Serving metrics: TTFT/TPOT, queue depth, occupancy, tokens/s.
+
+Parity: the serving-side telemetry DeepSpeed-MII exposes per deployment,
+comm_logger-styled: cheap counters updated by scheduler/engine hooks, a
+``summary()`` table on demand, and a ``write_to(monitor, step)`` bridge
+into the monitor/ backends (TensorBoard/W&B/CSV).
+
+Glossary (docs/serving.md):
+
+- **TTFT** — time to first token: first sampled token minus arrival.
+- **TPOT** — time per output token: (finish - first token) / (tokens - 1)
+  for requests that produced more than one token.
+- **queue depth** — requests admitted but not yet slotted (gauge).
+- **slot occupancy** — in-flight requests / max_slots (gauge).
+- **tokens/s** — sampled tokens over the engine-step window.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def percentile(values: List[float], p: float) -> float:
+    """Nearest-rank percentile; 0.0 on empty input (summary never dies)."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+class ServingMetrics:
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self._t0 = clock()
+        # counters
+        self.submitted = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.evicted = 0
+        self.finished = 0
+        self.steps = 0
+        self.tokens_out = 0
+        self.scheduled_tokens = 0     # real tokens fed (prefill + decode)
+        # gauges (last observed)
+        self.queue_depth = 0
+        self.slot_occupancy = 0.0
+        self._max_slots = 1
+        # per-request samples
+        self.ttft_s: List[float] = []
+        self.tpot_s: List[float] = []
+        self.queue_wait_s: List[float] = []
+        self.evict_reasons: Dict[str, int] = defaultdict(int)
+
+    # ------------------------------------------------- scheduler hooks
+    def on_submit(self, state, now: float, queue_depth: int = 0) -> None:
+        self.submitted += 1
+        self.queue_depth = queue_depth
+
+    def on_admit(self, state, now: float, queue_depth: int = 0) -> None:
+        self.admitted += 1
+        self.queue_depth = queue_depth
+        self.queue_wait_s.append(now - state.arrival_t)
+
+    def on_evict(self, state, now: float) -> None:
+        # graceful admission rejection and timeout eviction both land
+        # here; the reason string separates them
+        self.evicted += 1
+        if (state.evict_reason or "").startswith("queue full"):
+            self.rejected += 1
+        self.evict_reasons[state.evict_reason or "unknown"] += 1
+
+    def on_plan(self, plan, now: float, queue_depth: int = 0,
+                occupancy: int = 0) -> None:
+        self.queue_depth = queue_depth
+        self.slot_occupancy = occupancy / max(self._max_slots, 1)
+        self.scheduled_tokens += plan.total_tokens
+
+    def on_token(self, state, now: float) -> None:
+        self.tokens_out += 1
+
+    def on_finish(self, state, now: float) -> None:
+        self.finished += 1
+        if state.first_token_t is not None:
+            self.ttft_s.append(state.first_token_t - state.arrival_t)
+            n = len(state.tokens)
+            if n > 1 and state.finish_t is not None:
+                self.tpot_s.append(
+                    (state.finish_t - state.first_token_t) / (n - 1)
+                )
+
+    # --------------------------------------------------- engine hooks
+    def configure(self, max_slots: int) -> None:
+        self._max_slots = max(int(max_slots), 1)
+
+    def on_step(self) -> None:
+        self.steps += 1
+
+    # ------------------------------------------------------ reporting
+    @property
+    def elapsed(self) -> float:
+        return self.clock() - self._t0
+
+    def tokens_per_s(self, window_s: Optional[float] = None) -> float:
+        dur = self.elapsed if window_s is None else window_s
+        return self.tokens_out / dur if dur > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+            "evicted": self.evicted,
+            "finished": self.finished,
+            "steps": self.steps,
+            "tokens_out": self.tokens_out,
+            "scheduled_tokens": self.scheduled_tokens,
+            "queue_depth": self.queue_depth,
+            "slot_occupancy": self.slot_occupancy,
+            "tokens_per_s": self.tokens_per_s(),
+            "ttft_p50_s": percentile(self.ttft_s, 50),
+            "ttft_p95_s": percentile(self.ttft_s, 95),
+            "tpot_p50_s": percentile(self.tpot_s, 50),
+            "tpot_p95_s": percentile(self.tpot_s, 95),
+            "queue_wait_p95_s": percentile(self.queue_wait_s, 95),
+        }
+
+    def summary(self) -> str:
+        """comm_logger-style table."""
+        s = self.snapshot()
+        lines = [
+            "serving metrics",
+            f"{'requests':<18}submitted={self.submitted} "
+            f"admitted={self.admitted} finished={self.finished} "
+            f"rejected={self.rejected} evicted={self.evicted}",
+            f"{'throughput':<18}{s['tokens_per_s']:.1f} tok/s over "
+            f"{self.elapsed:.2f}s ({self.steps} steps, "
+            f"{self.scheduled_tokens} scheduled tokens)",
+            f"{'ttft':<18}p50={s['ttft_p50_s'] * 1e3:.1f}ms "
+            f"p95={s['ttft_p95_s'] * 1e3:.1f}ms",
+            f"{'tpot':<18}p50={s['tpot_p50_s'] * 1e3:.1f}ms "
+            f"p95={s['tpot_p95_s'] * 1e3:.1f}ms",
+            f"{'gauges':<18}queue_depth={self.queue_depth} "
+            f"slot_occupancy={self.slot_occupancy:.2f}",
+        ]
+        if self.evict_reasons:
+            reasons = ", ".join(
+                f"{k}: {v}" for k, v in sorted(self.evict_reasons.items())
+            )
+            lines.append(f"{'evictions':<18}{reasons}")
+        return "\n".join(lines)
+
+    def write_to(self, monitor, step: int) -> None:
+        """Feed the monitor/ backends (Monitor.write_events event triples)."""
+        monitor.write_events([
+            (f"Serving/{k}", float(v), step)
+            for k, v in self.snapshot().items()
+        ])

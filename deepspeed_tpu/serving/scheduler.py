@@ -1,0 +1,297 @@
+"""Continuous-batching scheduler: admission, SplitFuse interleave, slots.
+
+Parity: DeepSpeed-MII / FastGen's Dynamic SplitFuse scheduler. Every
+engine step gets a :class:`StepPlan` of fixed shape
+``[max_slots, token_budget]`` built under three invariants:
+
+1. **Token budget** — at most ``token_budget`` REAL tokens are scheduled
+   per step (sum of per-slot ``num_new``). Decode slots are served first
+   (one token each — they are latency-critical and starving them inflates
+   every in-flight request's TPOT); leftover budget goes to prompt chunks
+   FCFS, so long prompts "split" across steps and "fuse" with running
+   decodes instead of monopolizing a step.
+2. **Frontier** — a slot's ``start_pos`` always equals its cached token
+   count; the engine writes the chunk there, so cache contents beyond a
+   slot's frontier are never attendable (see models/decoding.py).
+3. **Bounded queue** — admission beyond ``queue_limit`` is rejected
+   GRACEFULLY (an EVICTED state with a ``retry_after`` backoff hint, not
+   an exception); queued requests older than ``request_timeout_s`` are
+   evicted the same way with exponential backoff on resubmission.
+
+The clock is injected (``clock=``) so eviction and timing are unit
+testable with a fake clock.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist
+from .request import Request, RequestState, RequestStatus
+
+
+@dataclass
+class ScheduledWork:
+    """One slot's share of a step."""
+
+    slot: int
+    state: RequestState
+    n_tokens: int          # real tokens fed this step
+    sample: bool           # does this step produce a token for the slot?
+
+
+@dataclass
+class StepPlan:
+    """Fixed-shape arrays for ONE jitted engine step."""
+
+    tokens: np.ndarray      # [max_slots, token_budget] int32 (0-padded)
+    num_new: np.ndarray     # [max_slots] int32 (0 = slot idle this step)
+    start_pos: np.ndarray   # [max_slots] int32 (slot frontier)
+    fresh: np.ndarray       # [max_slots] bool (slot newly allocated)
+    sample: np.ndarray      # [max_slots] bool
+    work: List[ScheduledWork] = field(default_factory=list)
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self.num_new.sum())
+
+
+class Scheduler:
+    def __init__(
+        self,
+        max_slots: int,
+        token_budget: int,
+        queue_limit: int = 64,
+        request_timeout_s: float = 60.0,
+        eviction_backoff_s: float = 1.0,
+        max_tokens: int = 1024,
+        clock: Callable[[], float] = time.monotonic,
+        metrics=None,
+    ):
+        self.max_slots = int(max_slots)
+        self.token_budget = int(token_budget)
+        self.queue_limit = int(queue_limit)
+        self.request_timeout_s = float(request_timeout_s)
+        self.eviction_backoff_s = float(eviction_backoff_s)
+        self.max_tokens = int(max_tokens)
+        self.clock = clock
+        self.metrics = metrics
+        self.queue: List[RequestState] = []           # FCFS admission queue
+        self.slots: List[Optional[RequestState]] = [None] * self.max_slots
+        self._free: List[int] = list(range(self.max_slots - 1, -1, -1))
+        self._fresh: set = set()  # slots allocated since their first step
+        self._decode_rr = 0  # rotating decode start: fairness when the
+                             # token budget cannot cover every decode slot
+
+    # -------------------------------------------------------------- intake
+    def submit(self, request: Request) -> RequestState:
+        """Admit (or gracefully reject) one request. Always returns the
+        state; check ``state.status`` — EVICTED means rejected, with
+        ``retry_after``/``evict_reason`` saying when/why."""
+        now = self.clock()
+        state = RequestState(request=request, arrival_t=now)
+        state.attempts = 1
+        return self._enqueue(state, now)
+
+    def resubmit(self, state: RequestState) -> RequestState:
+        """Retry a previously evicted request (backoff already elapsed is
+        the caller's business; the scheduler only counts attempts)."""
+        if state.status is not RequestStatus.EVICTED:
+            raise ValueError(
+                f"resubmit needs an EVICTED state, got {state.status.value}"
+            )
+        now = self.clock()
+        state.transition(RequestStatus.QUEUED)
+        state.arrival_t = now
+        state.attempts += 1
+        state.retry_after = None
+        state.evict_reason = None
+        return self._enqueue(state, now)
+
+    def _enqueue(self, state: RequestState, now: float) -> RequestState:
+        req = state.request
+        # every submission counts as submitted, including the ones the
+        # checks below reject — 'submitted >= rejected' must always hold
+        if self.metrics is not None:
+            self.metrics.on_submit(state, now, queue_depth=len(self.queue))
+        if req.prompt.size + req.max_new_tokens > self.max_tokens:
+            return self._evict(
+                state, now,
+                f"prompt+max_new_tokens {req.prompt.size + req.max_new_tokens}"
+                f" exceeds serving.max_tokens {self.max_tokens}",
+            )
+        # admission is EAGER: drain waiters into free slots before judging
+        # the bound, so a bounded queue never rejects while capacity idles
+        self._admit_to_slots(now)
+        if self.queue_limit and len(self.queue) >= self.queue_limit:
+            return self._evict(state, now, "queue full")
+        self.queue.append(state)
+        self._admit_to_slots(now)  # the arrival itself may slot immediately
+        return state
+
+    def _evict(self, state: RequestState, now: float,
+               reason: str) -> RequestState:
+        if state.status is RequestStatus.QUEUED and state in self.queue:
+            self.queue.remove(state)
+        if state.status is not RequestStatus.EVICTED:
+            state.transition(RequestStatus.EVICTED)
+        # exponential backoff: each failed attempt doubles the retry hint
+        state.retry_after = now + self.eviction_backoff_s * (
+            2 ** max(state.attempts - 1, 0)
+        )
+        state.evict_reason = reason
+        state.finish_t = now
+        if state.slot is not None:
+            self.release(state.slot)
+            state.slot = None
+        if self.metrics is not None:
+            self.metrics.on_evict(state, now)
+        log_dist(f"serving: evicted {state.request.request_id}: {reason}")
+        return state
+
+    # ------------------------------------------------------------- slots
+    def release(self, slot: int) -> None:
+        """Recycle a slot (its KV range is dead past the next frontier)."""
+        if self.slots[slot] is not None:
+            self.slots[slot] = None
+            self._free.append(slot)
+            self._fresh.discard(slot)
+
+    def evict_timeouts(self) -> List[RequestState]:
+        """Evict queued requests that waited past request_timeout_s."""
+        now = self.clock()
+        timed_out = [
+            s for s in self.queue
+            if now - s.arrival_t > self.request_timeout_s
+        ]
+        return [self._evict(s, now, "queue timeout") for s in timed_out]
+
+    def _admit_to_slots(self, now: float) -> None:
+        while self._free and self.queue:
+            state = self.queue.pop(0)  # FCFS
+            slot = self._free.pop()
+            state.slot = slot
+            state.transition(RequestStatus.PREFILL)
+            state.prefill_start_t = now
+            self.slots[slot] = state
+            self._fresh.add(slot)
+            if self.metrics is not None:
+                self.metrics.on_admit(state, now,
+                                      queue_depth=len(self.queue))
+
+    # -------------------------------------------------------------- plan
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or self.active_count > 0
+
+    def plan(self) -> Optional[StepPlan]:
+        """Build the next step's fixed-shape work, or None when idle."""
+        now = self.clock()
+        self.evict_timeouts()
+        self._admit_to_slots(now)
+        N, W = self.max_slots, self.token_budget
+        plan = StepPlan(
+            tokens=np.zeros((N, W), np.int32),
+            num_new=np.zeros(N, np.int32),
+            start_pos=np.zeros(N, np.int32),
+            fresh=np.zeros(N, np.bool_),
+            sample=np.zeros(N, np.bool_),
+        )
+        budget = W
+        # decodes first: latency-critical, one token each. The scan starts
+        # at a ROTATING index so a budget smaller than the decode count
+        # round-robins across steps instead of deterministically starving
+        # the high-index slots.
+        for off in range(N):
+            slot = (self._decode_rr + off) % N
+            state = self.slots[slot]
+            if state is None or state.status is not RequestStatus.DECODE:
+                continue
+            if budget < 1:
+                break
+            tok = state.tokens[-1]
+            pos = state.prompt_len + len(state.tokens) - 1
+            plan.tokens[slot, 0] = tok
+            plan.num_new[slot] = 1
+            plan.start_pos[slot] = pos
+            plan.sample[slot] = True
+            plan.work.append(ScheduledWork(slot, state, 1, True))
+            budget -= 1
+        self._decode_rr = (self._decode_rr + 1) % N
+        # leftover budget to prompt chunks, FCFS by prefill start
+        prefills = sorted(
+            (
+                (slot, state) for slot, state in enumerate(self.slots)
+                if state is not None
+                and state.status is RequestStatus.PREFILL
+            ),
+            key=lambda it: (it[1].prefill_start_t, it[0]),
+        )
+        for slot, state in prefills:
+            if budget < 1:
+                break
+            chunk = min(budget, state.prompt_remaining, W)
+            lo = state.prompt_pos
+            plan.tokens[slot, :chunk] = state.request.prompt[lo: lo + chunk]
+            plan.num_new[slot] = chunk
+            plan.start_pos[slot] = lo
+            final = lo + chunk == state.prompt_len
+            plan.sample[slot] = final
+            plan.fresh[slot] = slot in self._fresh
+            self._fresh.discard(slot)
+            plan.work.append(ScheduledWork(slot, state, chunk, final))
+            budget -= chunk
+        # inactive slots keep num_new=0 and start_pos=0; the ENGINE
+        # repoints their padded W-wide cache write at the dead tail
+        # margin (ServingEngine._run_plan), so an idle-but-active slot
+        # never clobbers its own cached tokens
+        if not plan.work:
+            return None
+        if self.metrics is not None:
+            self.metrics.on_plan(plan, now, queue_depth=len(self.queue),
+                                 occupancy=self.active_count)
+        return plan
+
+    # ---------------------------------------------------------- complete
+    def complete(self, plan: StepPlan, next_tokens: np.ndarray,
+                 new_rng: Optional[np.ndarray] = None
+                 ) -> List[RequestState]:
+        """Fold one executed step back into request state. Returns the
+        requests that finished this step (slots already recycled)."""
+        now = self.clock()
+        finished: List[RequestState] = []
+        for w in plan.work:
+            st = w.state
+            if w.n_tokens and st.status is RequestStatus.PREFILL:
+                st.prompt_pos += w.n_tokens
+            if not w.sample:
+                continue
+            tok = int(next_tokens[w.slot])
+            if new_rng is not None:
+                st.rng = new_rng[w.slot]
+            if st.first_token_t is None:
+                st.first_token_t = now
+            st.tokens.append(tok)
+            if st.status is RequestStatus.PREFILL:
+                st.transition(RequestStatus.DECODE)
+            req = st.request
+            hit_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
+            if hit_eos or len(st.tokens) >= req.max_new_tokens:
+                st.transition(RequestStatus.DONE)
+                st.finish_t = now
+                self.release(st.slot)
+                finished.append(st)
+            if self.metrics is not None:
+                self.metrics.on_token(st, now)
+        if self.metrics is not None:
+            for st in finished:
+                self.metrics.on_finish(st, now)
+        return finished

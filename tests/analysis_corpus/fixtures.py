@@ -4,6 +4,8 @@ Every builder returns ``(closed_jaxpr, lint_kwargs, expect_rule)`` —
 trace-ready evidence of one statically-visible bug class:
 
 - ``stacked_dim0_drift``    R2: the PR-1 bucketed-opt carry drift
+- ``slot_cache_carry_drift`` R2: a serving slot-KV arena whose step
+  carry re-puts the head partition onto the slot dim
 - ``missing_psum_grads``    R1: dp-local grads applied as if reduced
 - ``broken_ppermute_ring``  R3: a pipeline ring with a stray edge
 - ``read_after_donate``     R4: a rotating slot read after overwrite
@@ -64,6 +66,46 @@ def stacked_dim0_drift():
 def stacked_dim0_drift_clean():
     mesh = corpus_mesh()
     return _drift_scan(mesh, False), {"mesh": mesh}, "R2"
+
+
+# ------------------------------------------------------------------ R2 bis
+def _slot_cache_scan(mesh, drift: bool):
+    """The serving engine's slot-KV-arena carry: the arena
+    [slots, capacity, kv*hd] rests with cache heads over tp and is
+    carried through the step loop (frontier writes via
+    dynamic_update_slice). The drifted form re-puts the carry with the
+    head partition swapped onto the slot dim — exactly the bug a serving
+    step whose cache write loses its sharding constraint would compile
+    to (per-step reshard of the whole arena on real ICI)."""
+    resting = NamedSharding(mesh, P(None, None, "tp"))
+    writeback = NamedSharding(
+        mesh, P("dp", None, None) if drift else P(None, None, "tp")
+    )
+
+    def step(arena):
+        arena = lax.with_sharding_constraint(arena, resting)
+
+        def body(c, _):
+            chunk = jnp.ones((4, 2, 16), c.dtype)  # one step's KV writes
+            c = lax.dynamic_update_slice(c, chunk, (0, 0, 0))
+            c = jax.device_put(c, writeback)  # the step's carry-out
+            return c, ()
+
+        y, _ = lax.scan(body, arena, None, length=3)
+        return y
+
+    sds = jax.ShapeDtypeStruct((4, 8, 16), jnp.float32)
+    return jax.make_jaxpr(step)(sds)
+
+
+def slot_cache_carry_drift():
+    mesh = corpus_mesh()
+    return _slot_cache_scan(mesh, True), {"mesh": mesh}, "R2"
+
+
+def slot_cache_carry_drift_clean():
+    mesh = corpus_mesh()
+    return _slot_cache_scan(mesh, False), {"mesh": mesh}, "R2"
 
 
 # --------------------------------------------------------------------- R1
@@ -373,6 +415,7 @@ def unhideable_offload_stream_clean():
 
 HAZARDS = [
     stacked_dim0_drift,
+    slot_cache_carry_drift,
     missing_psum_grads,
     broken_ppermute_ring,
     read_after_donate,
@@ -386,6 +429,7 @@ HAZARDS = [
 
 CLEAN_TWINS = [
     stacked_dim0_drift_clean,
+    slot_cache_carry_drift_clean,
     missing_psum_grads_clean,
     broken_ppermute_ring_clean,
     read_after_donate_clean,

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""bench_serve: replay a synthetic Poisson arrival trace through the
+continuous-batching serving engine on a CPU mesh.
+
+    python tools/bench_serve.py --requests 16 --rate 8
+    python tools/bench_serve.py --tp 2 --kv-cache-dtype int8
+    python tools/bench_serve.py --check-recompiles   # CI gate: exit 1 if
+                                                     # the slot step traced
+                                                     # more than once
+
+Arrivals land on a VIRTUAL clock (exponential inter-arrival gaps at
+``--rate`` requests/s); each engine step advances the clock by its
+measured wall time, so TTFT/TPOT percentiles are real step seconds laid
+over the synthetic arrival pattern. Prompt/output lengths are drawn per
+request (seeded), exercising the ragged path the slot engine exists for.
+
+Prints tokens/s, p50/p95 TTFT/TPOT, queue/occupancy gauges, the KV-arena
+stream line (comm_logger intake), and the recompile counters — the
+zero-recompiles-after-warmup criterion is ``step traces == 1``.
+
+CPU numbers are NOT perf claims (PERF_NOTES.md protocol: nothing is
+banked until an on-chip A/B); this tool is the correctness/latency-shape
+replay harness.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_DIR not in sys.path:
+    sys.path.insert(0, REPO_DIR)
+
+
+class VirtualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def build_trace(args):
+    import numpy as np
+
+    r = np.random.RandomState(args.seed)
+    gaps = r.exponential(1.0 / args.rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    trace = []
+    for i in range(args.requests):
+        plen = int(r.randint(args.min_prompt, args.max_prompt + 1))
+        new = int(r.randint(args.min_new, args.max_new + 1))
+        prompt = r.randint(0, args.vocab, size=(plen,))
+        trace.append((float(arrivals[i]), f"req-{i}", prompt, new))
+    return trace
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_serve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="Poisson arrival rate, requests per virtual second")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--token-budget", type=int, default=16)
+    ap.add_argument("--min-prompt", type=int, default=4)
+    ap.add_argument("--max-prompt", type=int, default=24)
+    ap.add_argument("--min-new", type=int, default=2)
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--kv-cache-dtype", default="auto",
+                    choices=["auto", "bf16", "int8"])
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--check-recompiles", action="store_true",
+                    help="exit 1 unless the slot step compiled exactly once")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.profiling.comm_logger import CommsLogger
+    from deepspeed_tpu.serving import Request, ServingEngine, ServingMetrics
+
+    model = llama(
+        "llama-tiny", vocab_size=args.vocab, max_seq_len=64, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=4, intermediate_size=128,
+    )
+    topology = None
+    if args.tp > 1:
+        topology = MeshTopology(
+            dims=ParallelDims(tp=args.tp), devices=jax.devices()[:args.tp]
+        )
+    engine = deepspeed_tpu.init_inference(
+        model, dtype=jnp.float32, max_tokens=64, topology=topology,
+        kv_cache_dtype=args.kv_cache_dtype,
+        rng=jax.random.PRNGKey(args.seed),
+    )
+    clock = VirtualClock()
+    logger = CommsLogger()
+    srv = ServingEngine(
+        engine=engine,
+        clock=clock,
+        metrics=ServingMetrics(clock=clock),
+        comm_logger=logger,
+        serving={
+            "max_slots": args.slots,
+            "token_budget": args.token_budget,
+            "queue_limit": max(args.requests, 1),
+            "request_timeout_s": 1e9,  # the replay never times out
+            "max_tokens": 64,
+        },
+    )
+    trace = build_trace(args)
+    pending = list(trace)
+    t_wall0 = time.perf_counter()
+    while pending or srv.scheduler.has_work:
+        while pending and pending[0][0] <= clock():
+            at, rid, prompt, new = pending.pop(0)
+            srv.submit(Request(
+                request_id=rid, prompt=prompt, max_new_tokens=new,
+                temperature=args.temperature,
+            ))
+        if not srv.scheduler.has_work:
+            clock.advance(max(pending[0][0] - clock(), 1e-6))  # idle: jump
+            continue
+        t0 = time.perf_counter()
+        srv.step()
+        clock.advance(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_wall0
+
+    m = srv.metrics.snapshot()
+    print(srv.metrics.summary())
+    kv_line = logger.kv_summary(duration_s=clock())
+    if kv_line:
+        print(kv_line)
+    logger.stop()
+    print(
+        f"replay: {args.requests} requests over {clock():.2f} virtual s "
+        f"({wall:.2f}s wall), tokens/s={m['tokens_out'] / max(clock(), 1e-9):.1f}"
+    )
+    print(
+        f"p50/p95 TTFT = {m['ttft_p50_s'] * 1e3:.1f}/"
+        f"{m['ttft_p95_s'] * 1e3:.1f} ms, p50/p95 TPOT = "
+        f"{m['tpot_p50_s'] * 1e3:.1f}/{m['tpot_p95_s'] * 1e3:.1f} ms"
+    )
+    print(
+        f"recompiles: serving step traces={srv.step_traces} "
+        f"(zero-after-warmup criterion: 1), lockstep engine compiles="
+        f"{engine.num_compiles}"
+    )
+    if m["finished"] != args.requests:
+        print(f"ERROR: {args.requests - m['finished']} requests unfinished")
+        return 1
+    if args.check_recompiles and srv.step_traces != 1:
+        print("ERROR: the slot step recompiled after warmup")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
