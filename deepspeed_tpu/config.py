@@ -260,6 +260,28 @@ class ServingConfig:
     max_tokens: int = 1024       # per-request prompt+output cap (slot KV
                                  # capacity; clamped to model max_seq_len)
     kv_cache_dtype: str = "auto"  # auto | bf16 | bfloat16 | int8
+    paged: bool = False          # block-paged KV arena (vLLM / FastGen
+                                 # blocked-KV): a global page pool + per-slot
+                                 # page tables replaces the contiguous
+                                 # [max_slots, capacity] regions
+    page_size: int = 16          # tokens per KV page (paged mode)
+    num_pages: int = 0           # physical pages in the pool; 0 = auto
+                                 # (max_slots * pages_per_slot — no
+                                 # overcommit). Lower it to overcommit HBM;
+                                 # shardplan prices the pool (R6)
+    prefix_cache: bool = True    # hash-of-prefix → shared read-only pages
+                                 # with refcounts + copy-on-write (paged
+                                 # mode only)
+
+    def pages_per_slot(self, max_tokens: Optional[int] = None) -> int:
+        """Logical pages per slot: covers the per-request token cap plus
+        the token_budget write margin (padded chunk tails never leave the
+        mapped range). The ENGINE passes its clamped
+        ``min(serving.max_tokens, model max)`` — that value is
+        authoritative; without it this is the config-level upper bound."""
+        span = int(max_tokens if max_tokens is not None
+                   else self.max_tokens) + int(self.token_budget)
+        return -(-span // int(self.page_size))
 
     def validate(self) -> None:
         if int(self.max_slots) < 1:
@@ -284,6 +306,19 @@ class ServingConfig:
                 "serving.kv_cache_dtype must be auto|bf16|bfloat16|int8, "
                 f"got {self.kv_cache_dtype!r}"
             )
+        if int(self.page_size) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.page_size must be >= 1, got {self.page_size}"
+            )
+        if int(self.num_pages) < 0:
+            raise DeepSpeedConfigError(
+                f"serving.num_pages must be >= 0 (0 = auto), got "
+                f"{self.num_pages}"
+            )
+        # NOTE: the num_pages liveness floor (num_pages >= pages_per_slot)
+        # depends on the ENGINE-clamped max_tokens (min with the model's
+        # max_seq_len), so ServingEngine.__init__ / trace_serving_step
+        # enforce it — config validation alone cannot know the model.
 
 
 @dataclass

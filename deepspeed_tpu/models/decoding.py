@@ -63,6 +63,109 @@ def _update_scale_at(scale: jax.Array, new: jax.Array, cache_len) -> jax.Array:
     return lax.dynamic_update_slice(scale, new, (0, 0, cache_len, 0))
 
 
+def init_paged_cache(cfg: TransformerConfig, num_pages: int, page_size: int,
+                     dtype=jnp.bfloat16, quantized: bool = False) -> Cache:
+    """Block-paged KV pool for all layers (the serving engine's paged
+    arena): ``k``/``v`` are [L, num_pages + 1, page_size, KV, hd] — one
+    extra physical page at index ``num_pages`` is the NULL page, where
+    unmapped logical pages and idle slots' padded chunk writes land
+    (its bytes are garbage by design and never attendable: every query
+    masks at its own frontier). int8 storage carries per-(token, head)
+    scales in the pre-transposed [L, P+1, KV, page_size, SL] layout the
+    decode kernel consumes."""
+    P1 = int(num_pages) + 1
+    shape = (cfg.num_layers, P1, page_size, cfg.kv_heads, cfg.hd)
+    if quantized:
+        sshape = (cfg.num_layers, P1, cfg.kv_heads, page_size, SCALE_LANES)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+    }
+
+
+def _page_indices(cache_len: jax.Array, S: int, page_table: jax.Array,
+                  page_size: int):
+    """Per-token physical destination of a [B, S] chunk written at the
+    per-row frontier: (phys_page [B, S], offset [B, S])."""
+    mp = page_table.shape[1]
+    pos = cache_len[:, None].astype(jnp.int32) + jnp.arange(
+        S, dtype=jnp.int32
+    )[None, :]
+    pageidx = jnp.clip(pos // page_size, 0, mp - 1)
+    phys = jnp.take_along_axis(page_table, pageidx, axis=1)
+    return phys, pos % page_size
+
+
+def _paged_write(pool: jax.Array, new: jax.Array, cache_len,
+                 page_table: jax.Array) -> jax.Array:
+    """Scatter a chunk's new K/V [B, S, KV, hd] into the page pool
+    [P+1, page_size, KV, hd] through the per-slot page tables. Tokens
+    past a slot's mapped pages (padding) route to the NULL page the
+    tables point unmapped entries at."""
+    phys, off = _page_indices(cache_len, new.shape[1], page_table,
+                              pool.shape[1])
+    return pool.at[phys, off].set(new)
+
+
+def _paged_write_scale(pool: jax.Array, new: jax.Array, cache_len,
+                       page_table: jax.Array) -> jax.Array:
+    """Scale twin of :func:`_paged_write`: pool [P+1, KV, ps, SL], new
+    chunk scales [B, S, KV, SL] (the _quantize_kv layout)."""
+    phys, off = _page_indices(cache_len, new.shape[1], page_table,
+                              pool.shape[2])
+    kv = jnp.arange(pool.shape[1])
+    return pool.at[
+        phys[:, :, None], kv[None, None, :], off[:, :, None]
+    ].set(new)
+
+
+def _paged_gather(pool: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Per-slot contiguous K/V view [B, mp*ps, KV, hd] gathered from the
+    pool through the page tables — bitwise the bytes the contiguous
+    arena would hold at every mapped position."""
+    B, mp = page_table.shape
+    view = pool[page_table]  # [B, mp, ps, KV, hd]
+    return view.reshape(B, mp * pool.shape[1], *pool.shape[2:])
+
+
+def _paged_gather_scale(pool: jax.Array, page_table: jax.Array) -> jax.Array:
+    """[P+1, KV, ps, SL] pool → [B, KV, mp*ps, SL] per-slot scale view
+    (the dense scale-cache layout)."""
+    B, mp = page_table.shape
+    view = jnp.swapaxes(pool[page_table], 1, 2)  # [B, KV, mp, ps, SL]
+    return view.reshape(B, pool.shape[1], mp * pool.shape[2], pool.shape[3])
+
+
+def paged_cow_copy(cache: Cache, page_table: jax.Array, start_pos: jax.Array,
+                   cow_src: jax.Array) -> Cache:
+    """Copy-on-write inside the ONE jitted step: slots whose ``cow_src``
+    is a physical page id (>= 0) copy that page's KV — all layers, scales
+    included — onto their current frontier page BEFORE the chunk write,
+    so a slot diverging from a shared prefix mid-page keeps the shared
+    tokens without ever writing the shared page. Rows with
+    ``cow_src == -1`` degrade to a self-copy of their frontier page
+    (bitwise no-op), keeping the step at one trace for every COW mix."""
+    ps = cache["k"].shape[2]
+    N, mp = page_table.shape
+    rows = jnp.arange(N)
+    dst = page_table[rows, jnp.clip(start_pos // ps, 0, mp - 1)]
+    do = cow_src >= 0
+    src = jnp.where(do, jnp.maximum(cow_src, 0), dst)
+    out = {}
+    for key, pool in cache.items():
+        src_data = pool[:, src]  # [L, N, ...page]
+        cur = pool[:, dst]
+        sel = do.reshape((1, N) + (1,) * (pool.ndim - 2))
+        out[key] = pool.at[:, dst].set(jnp.where(sel, src_data, cur))
+    return out
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=jnp.bfloat16, quantized: bool = False) -> Cache:
     """Static KV ring buffer for all layers.
@@ -132,7 +235,7 @@ def _qkv(cfg: TransformerConfig, p: Params, x: jax.Array, positions: jax.Array):
 def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
                       positions: jax.Array, k_cache: jax.Array,
                       v_cache: jax.Array, cache_len,
-                      k_scale=None, v_scale=None):
+                      k_scale=None, v_scale=None, page_table=None):
     """Attend new tokens (x, [B,S,D]) against cache[:cache_len] + themselves.
 
     Returns (out, new_k_cache, new_v_cache[, new_k_scale, new_v_scale]).
@@ -148,22 +251,46 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
     harmless by the frontier invariant (a later query only attends
     kpos <= its own position, and every position is rewritten by its real
     token before any query can reach it).
+
+    ``page_table`` [B, max_pages] switches the cache operands to the
+    block-paged form: ``k_cache``/``v_cache`` are page POOLS
+    [P+1, page_size, KV, hd] (scales [P+1, KV, page_size, SL]) shared by
+    every slot. The chunk scatters to per-token (physical page, offset)
+    destinations FIRST, then attention reads a per-slot gathered view —
+    so the view holds bitwise the bytes the contiguous arena would, and
+    the attention math below is byte-for-byte the dense path.
     """
     B, S, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
-    S_max = k_cache.shape[1]
     q, k, v = _qkv(cfg, p, x, positions)
 
     quantized = k_scale is not None
+    paged = page_table is not None
     if quantized:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
-        k_cache = _update_at(k_cache, kq, cache_len)
-        v_cache = _update_at(v_cache, vq, cache_len)
-        # new-token scales transpose into the [B, KV, S, SL] cache layout —
-        # tiny ([B,S,KV,SL]); the big int8 value caches never relayout
-        k_scale = _update_scale_at(k_scale, jnp.swapaxes(ks, 1, 2), cache_len)
-        v_scale = _update_scale_at(v_scale, jnp.swapaxes(vs, 1, 2), cache_len)
+        if paged:
+            k_cache = _paged_write(k_cache, kq, cache_len, page_table)
+            v_cache = _paged_write(v_cache, vq, cache_len, page_table)
+            k_scale = _paged_write_scale(k_scale, ks, cache_len, page_table)
+            v_scale = _paged_write_scale(v_scale, vs, cache_len, page_table)
+        else:
+            k_cache = _update_at(k_cache, kq, cache_len)
+            v_cache = _update_at(v_cache, vq, cache_len)
+            # new-token scales transpose into the [B, KV, S, SL] cache
+            # layout — tiny ([B,S,KV,SL]); the big int8 value caches never
+            # relayout
+            k_scale = _update_scale_at(
+                k_scale, jnp.swapaxes(ks, 1, 2), cache_len
+            )
+            v_scale = _update_scale_at(
+                v_scale, jnp.swapaxes(vs, 1, 2), cache_len
+            )
+    elif paged:
+        k_cache = _paged_write(k_cache, k.astype(k_cache.dtype), cache_len,
+                               page_table)
+        v_cache = _paged_write(v_cache, v.astype(v_cache.dtype), cache_len,
+                               page_table)
     else:
         k_cache = _update_at(k_cache, k.astype(k_cache.dtype), cache_len)
         v_cache = _update_at(v_cache, v.astype(v_cache.dtype), cache_len)
@@ -173,7 +300,40 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
             return out, k_cache, v_cache, k_scale, v_scale
         return out, k_cache, v_cache
 
-    if isinstance(cache_len, int) and cache_len == 0 and S > 1:
+    if paged:
+        if S == 1 and cfg.pos_embedding != "alibi":
+            # single-token paged decode: the Pallas kernel gathers K/V
+            # page-by-page through the table (scalar prefetch drives the
+            # block index map) — no [B, capacity] view materializes
+            from ..ops.attention import _resolve
+
+            if _resolve() == "flash":
+                from ..ops.pallas.decode_attention import decode_attention
+
+                out = decode_attention(
+                    q, k_cache, v_cache, cache_len,
+                    k_scale=k_scale, v_scale=v_scale, page_table=page_table,
+                )
+                if out is not None:
+                    out = out.astype(x.dtype).reshape(B, S, nh * hd)
+                    out = _out_proj(out, p["wo"])
+                    if cfg.use_bias:
+                        out = out + p["bo"]
+                    return ret(out)
+        # XLA path: gather the per-slot contiguous views (post-write, so
+        # they reproduce the dense arena bitwise) and fall through to the
+        # shared attention math below
+        k_att = _paged_gather(k_cache, page_table)
+        v_att = _paged_gather(v_cache, page_table)
+        ks_att = _paged_gather_scale(k_scale, page_table) if quantized \
+            else None
+        vs_att = _paged_gather_scale(v_scale, page_table) if quantized \
+            else None
+    else:
+        k_att, v_att, ks_att, vs_att = k_cache, v_cache, k_scale, v_scale
+    S_max = k_att.shape[1]
+
+    if not paged and isinstance(cache_len, int) and cache_len == 0 and S > 1:
         # fresh prefill: the new tokens only attend among themselves, so the
         # registered attention impl applies (kernel injection: Pallas flash
         # prefill on TPU); the decode matvec below stays the einsum path
@@ -201,8 +361,8 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
             from ..ops.pallas.decode_attention import decode_attention
 
             out = decode_attention(
-                q, k_cache, v_cache, cache_len,
-                k_scale=k_scale, v_scale=v_scale,
+                q, k_att, v_att, cache_len,
+                k_scale=ks_att, v_scale=vs_att,
             )
             if out is not None:
                 out = out.astype(x.dtype).reshape(B, S, nh * hd)
@@ -211,13 +371,13 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
                     out = out + p["bo"]
                 return ret(out)
 
-    kf = k_cache.astype(jnp.float32)
-    vf = v_cache.astype(jnp.float32)
+    kf = k_att.astype(jnp.float32)
+    vf = v_att.astype(jnp.float32)
     if quantized:
         # scale cache is [B, KV, Smax, SL]; align to the [B, Smax, KV, hd]
         # value layout for the dense dequant (fallback path only)
-        kf = kf * jnp.swapaxes(k_scale, 1, 2)[..., :1]
-        vf = vf * jnp.swapaxes(v_scale, 1, 2)[..., :1]
+        kf = kf * jnp.swapaxes(ks_att, 1, 2)[..., :1]
+        vf = vf * jnp.swapaxes(vs_att, 1, 2)[..., :1]
     if nkv != nh:
         kf = jnp.repeat(kf, nh // nkv, axis=2)
         vf = jnp.repeat(vf, nh // nkv, axis=2)
@@ -247,13 +407,18 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
 
 def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
                        cache: Cache, cache_len, *,
-                       dtype=jnp.bfloat16) -> Tuple[jax.Array, Cache]:
+                       dtype=jnp.bfloat16,
+                       page_table=None) -> Tuple[jax.Array, Cache]:
     """Run new tokens through all layers against the cache.
 
     input_ids: [B, S] (prefill) or [B, 1] (decode). cache_len: tokens already
     cached — a shared scalar, or a per-row [B] vector for the serving
     engine's ragged slot batch. Returns (fp32 logits [B, S, V], updated
     cache).
+
+    ``page_table`` [B, max_pages] switches ``cache`` to the block-paged
+    pool form (init_paged_cache): every layer scatters its chunk through
+    the shared table and attends a gathered per-slot view.
     """
     B, S = input_ids.shape
     from ..ops.quantizer import cast_floating
@@ -284,14 +449,14 @@ def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Ar
             layer, kc, vc, ks, vs = scanned
             a, kc, vc, ks, vs = _cached_attention(
                 cfg, layer["attn"], _norm(cfg, layer["ln1"], h), positions,
-                kc, vc, cache_len, ks, vs,
+                kc, vc, cache_len, ks, vs, page_table=page_table,
             )
             new_cache = (kc, vc, ks, vs)
         else:
             layer, kc, vc = scanned
             a, kc, vc = _cached_attention(
                 cfg, layer["attn"], _norm(cfg, layer["ln1"], h), positions,
-                kc, vc, cache_len,
+                kc, vc, cache_len, page_table=page_table,
             )
             new_cache = (kc, vc)
         h = h + a

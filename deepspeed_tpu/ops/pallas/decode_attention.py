@@ -32,6 +32,47 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_S = 256
 
 
+def _tile_update(q, k, v, ks, vs, start, cl, scale, m_scr, l_scr, acc_scr):
+    """One [block_s, hd] K/V tile's contribution to the fp32 online
+    softmax (shared by the dense and paged kernels): dequantize when
+    scales ride along, mask past the row's frontier, fold into the
+    running (max, sum, acc) scratches."""
+    if ks is not None:
+        # int8 cache: dequantize the tile with its per-token scales
+        k = (k.astype(jnp.float32) * ks[:, :1]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs[:, :1]).astype(q.dtype)
+    elif k.dtype != q.dtype:
+        # mixed storage (kv_cache_dtype="bf16" on an fp32 engine): the
+        # MXU matmul needs matching operand dtypes
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, block_s]
+    kpos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kpos <= cl, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    corr = jnp.exp(m_prev - m_safe)
+    l_scr[:] = jnp.broadcast_to(
+        l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True), l_scr.shape
+    )
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+
+def _finalize_out(o_ref, l_scr, acc_scr):
+    l = l_scr[:, :1]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
 def _decode_kernel(*refs, scale, block_s, has_scales=False):
     if has_scales:
         (q_ref, k_ref, v_ref, ks_ref, vs_ref, cl_ref, o_ref,
@@ -55,43 +96,58 @@ def _decode_kernel(*refs, scale, block_s, has_scales=False):
 
     @pl.when(start <= cl)  # skip tiles entirely past the live cache
     def _body():
-        q = q_ref[0, 0]  # [G, hd]
-        k = k_ref[0]  # [block_s, hd] (storage dtype; flat head-column view)
-        v = v_ref[0]
-        if has_scales:
-            # int8 cache: dequantize the tile with its per-token scales
-            k = (k.astype(jnp.float32) * ks_ref[0, 0][:, :1]).astype(q.dtype)
-            v = (v.astype(jnp.float32) * vs_ref[0, 0][:, :1]).astype(q.dtype)
-        elif k.dtype != q.dtype:
-            # mixed storage (kv_cache_dtype="bf16" on an fp32 engine): the
-            # MXU matmul needs matching operand dtypes
-            k = k.astype(q.dtype)
-            v = v.astype(q.dtype)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [G, block_s]
-        kpos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kpos <= cl, s, NEG_INF)
-
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - m_safe)
-        corr = jnp.exp(m_prev - m_safe)
-        l_scr[:] = jnp.broadcast_to(
-            l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True), l_scr.shape
+        _tile_update(
+            q_ref[0, 0], k_ref[0], v_ref[0],
+            ks_ref[0, 0] if has_scales else None,
+            vs_ref[0, 0] if has_scales else None,
+            start, cl, scale, m_scr, l_scr, acc_scr,
         )
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(si == ns - 1)
     def _finalize():
-        l = l_scr[:, :1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        _finalize_out(o_ref, l_scr, acc_scr)
+
+
+def _paged_decode_kernel(*refs, scale, page_size, has_scales=False):
+    """Paged twin of :func:`_decode_kernel`: the grid's third axis walks a
+    slot's LOGICAL pages; the page table rides as a scalar-prefetch
+    operand so the BlockSpec index maps fetch each physical K/V page
+    directly from the pool — no per-slot contiguous view ever
+    materializes in HBM. Per-row frontier predication is unchanged
+    (logical position = si * page_size + offset)."""
+    if has_scales:
+        (pt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (pt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+        ks_ref = vs_ref = None
+    del pt_ref  # consumed by the index maps
+    b = pl.program_id(0)
+    si = pl.program_id(2)
+    ns = pl.num_programs(2)
+    cl = cl_ref[b]
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = si * page_size
+
+    @pl.when(start <= cl)  # pages past the frontier are unmapped — skip
+    def _body():
+        _tile_update(
+            q_ref[0, 0], k_ref[0], v_ref[0],
+            ks_ref[0, 0] if has_scales else None,
+            vs_ref[0, 0] if has_scales else None,
+            start, cl, scale, m_scr, l_scr, acc_scr,
+        )
+
+    @pl.when(si == ns - 1)
+    def _finalize():
+        _finalize_out(o_ref, l_scr, acc_scr)
 
 
 def _pick_block(S: int, preferred: int) -> Optional[int]:
@@ -184,16 +240,106 @@ def decode_attention_kernel(q, k_cache, v_cache, cache_len, *,
     return out.reshape(B, 1, H, hd)
 
 
+def paged_decode_attention_kernel(q, k_pool, v_pool, cache_len, page_table,
+                                  *, k_scale=None, v_scale=None,
+                                  interpret: Optional[bool] = None):
+    """q [B,1,H,hd] new-token queries vs a block-paged KV pool
+    k/v_pool [P+1, page_size, KV, hd] addressed through per-slot page
+    tables [B, max_pages] (int32 physical page per logical page; unmapped
+    entries point at the NULL page and are predicated off by the
+    frontier). ``cache_len`` is the per-row [B] frontier. The page table
+    and frontier ride as scalar-prefetch operands
+    (pltpu.PrefetchScalarGridSpec) so the block index maps gather each
+    K/V page straight from the pool — the paged analogue of vLLM's
+    block-table attention, per-row online softmax unchanged. int8 pools
+    pass per-token scales [P+1, KV, page_size, SCALE_LANES].
+    """
+    B, one, H, hd = q.shape
+    assert one == 1, "paged decode kernel is single-token"
+    P1, ps, KV = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    mp = page_table.shape[1]
+    G = H // KV
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / (hd**0.5)
+    qg = q.reshape(B, KV, G, hd)
+    pt = jnp.asarray(page_table, jnp.int32)
+    cl = jnp.broadcast_to(
+        jnp.asarray(cache_len, jnp.int32).reshape(-1), (B,)
+    )
+    has_scales = k_scale is not None
+
+    # flat head-column view of the pool (same lane-alignment contract as
+    # the dense kernel); a (1, ps, hd) block's trailing dims equal the
+    # array dims, so any 8-aligned page_size tiles legally
+    operands = [
+        qg,
+        k_pool.reshape(P1, ps, KV * hd),
+        v_pool.reshape(P1, ps, KV * hd),
+    ]
+    in_specs = [
+        pl.BlockSpec((1, 1, G, hd), lambda b, kv, si, pt, cl: (b, kv, 0, 0)),
+        pl.BlockSpec((1, ps, hd),
+                     lambda b, kv, si, pt, cl: (pt[b, si], 0, kv)),
+        pl.BlockSpec((1, ps, hd),
+                     lambda b, kv, si, pt, cl: (pt[b, si], 0, kv)),
+    ]
+    if has_scales:
+        SL = k_scale.shape[-1]
+        operands += [k_scale, v_scale]
+        in_specs += [
+            pl.BlockSpec((1, 1, ps, SL),
+                         lambda b, kv, si, pt, cl: (pt[b, si], kv, 0, 0)),
+            pl.BlockSpec((1, 1, ps, SL),
+                         lambda b, kv, si, pt, cl: (pt[b, si], kv, 0, 0)),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, cache_len
+        grid=(B, KV, mp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, hd),
+                               lambda b, kv, si, pt, cl: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, LANES), jnp.float32),
+            pltpu.VMEM((G, LANES), jnp.float32),
+            pltpu.VMEM((G, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, scale=scale, page_size=ps,
+            has_scales=has_scales,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(pt, cl, *operands)
+    return out.reshape(B, 1, H, hd)
+
+
 def decode_attention(q, k_cache, v_cache, cache_len, *,
-                     k_scale=None, v_scale=None,
+                     k_scale=None, v_scale=None, page_table=None,
                      interpret: Optional[bool] = None):
     """Shard-map-aware wrapper: cache heads over tp, batch over dp/fsdp —
     mirrors flash_attention's serving layout. Returns None if the shapes
-    don't fit the kernel (caller falls back to the XLA matvec)."""
+    don't fit the kernel (caller falls back to the XLA matvec).
+
+    ``page_table`` [B, max_pages] switches to the block-paged form:
+    k/v_cache are then page POOLS [P+1, page_size, KV, hd] (int8 scales
+    [P+1, KV, page_size, SL]) and the kernel gathers pages through the
+    table instead of streaming a contiguous per-slot region."""
     from ...models.sharding import current_topology
 
     B, one, H, hd = q.shape
-    Smax, KV = k_cache.shape[1], k_cache.shape[2]
+    paged = page_table is not None
+    if paged:
+        ps, KV = k_cache.shape[1], k_cache.shape[2]
+        Smax = page_table.shape[1] * ps
+    else:
+        Smax, KV = k_cache.shape[1], k_cache.shape[2]
     topo = current_topology()
     distributed = topo is not None and topo.world_size > 1
     tp = topo.tp_size if distributed else 1
@@ -207,7 +353,9 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
         reasons.append(f"H={H} not a multiple of KV={KV}")
     if hd % 8 != 0:
         reasons.append(f"head_dim {hd} not 8-aligned")
-    if _pick_block(Smax, DEFAULT_BLOCK_S) is None:
+    if paged and ps % 8 != 0:
+        reasons.append(f"page_size {ps} not 8-aligned")
+    if not paged and _pick_block(Smax, DEFAULT_BLOCK_S) is None:
         reasons.append(f"cache length {Smax} has no 8-aligned block")
     if not interp and hd % LANES != 0 and KV // max(tp, 1) != 1:
         # the flat head-column view needs lane-aligned per-head offsets on
@@ -227,6 +375,11 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
         return None
 
     if not distributed:
+        if paged:
+            return paged_decode_attention_kernel(
+                q, k_cache, v_cache, cache_len, page_table,
+                k_scale=k_scale, v_scale=v_scale, interpret=interp,
+            )
         return decode_attention_kernel(
             q, k_cache, v_cache, cache_len,
             k_scale=k_scale, v_scale=v_scale, interpret=interp,
@@ -240,26 +393,46 @@ def decode_attention(q, k_cache, v_cache, cache_len, *,
     b_ax = batch_axes if batch_axes else None
     h_ax = "tp" if tp > 1 else None
     has_scales = k_scale is not None
-    kv_spec = P(b_ax, None, h_ax, None)
+    if paged:
+        # page pools are slot-agnostic: heads over tp, pages replicated;
+        # the table and frontier ride with the (slot) batch
+        kv_spec = P(None, None, h_ax, None)
+        scale_spec = P(None, h_ax, None, None)
+        q_spec = P(b_ax, None, h_ax, None)
+    else:
+        kv_spec = P(b_ax, None, h_ax, None)
+        scale_spec = P(b_ax, h_ax, None, None)
+        q_spec = P(b_ax, None, h_ax, None)
     operands = [q, k_cache, v_cache]
-    in_specs = [P(b_ax, None, h_ax, None), kv_spec, kv_spec]
+    in_specs = [q_spec, kv_spec, kv_spec]
     if has_scales:
-        # scales are [B, KV, Smax, SCALE_LANES]: head dim 1 follows tp
+        # dense scales are [B, KV, Smax, SL] (head dim 1 follows tp);
+        # paged scales [P+1, KV, ps, SL] shard the same head dim
         operands += [k_scale, v_scale]
-        in_specs += [P(b_ax, h_ax, None, None), P(b_ax, h_ax, None, None)]
+        in_specs += [scale_spec, scale_spec]
     # the frontier rides as a per-row [B] vector sharded with the batch
     # (a scalar cache_len broadcasts — every shard sees the same value)
     operands.append(jnp.broadcast_to(
         jnp.asarray(cache_len, jnp.int32).reshape(-1), (B,)
     ))
     in_specs.append(P(b_ax))
+    if paged:
+        operands.append(jnp.asarray(page_table, jnp.int32))
+        in_specs.append(P(b_ax, None))
 
     def body(q, kc, vc, *rest):
+        rest = list(rest)
+        pt = rest.pop() if paged else None
         if has_scales:
             ks, vs, cl = rest
         else:
             (cl,) = rest
             ks = vs = None
+        if paged:
+            return paged_decode_attention_kernel(
+                q, kc, vc, cl, pt,
+                k_scale=ks, v_scale=vs, interpret=interp,
+            )
         return decode_attention_kernel(
             q, kc, vc, cl, k_scale=ks, v_scale=vs, interpret=interp
         )

@@ -7,12 +7,16 @@ docs/serving.md for architecture, scheduler invariants, config keys and
 the metrics glossary.
 """
 
-from .engine import ServingEngine, make_step_fn, trace_serving_step
+from .engine import (ServingEngine, make_paged_step_fn, make_step_fn,
+                     trace_serving_step)
 from .metrics import ServingMetrics
+from .paging import PagePool, PrefixCache
 from .request import Request, RequestState, RequestStatus, request_rng
 from .scheduler import Scheduler, StepPlan
 
 __all__ = [
+    "PagePool",
+    "PrefixCache",
     "Request",
     "RequestState",
     "RequestStatus",
@@ -20,6 +24,7 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "StepPlan",
+    "make_paged_step_fn",
     "make_step_fn",
     "request_rng",
     "trace_serving_step",

@@ -26,6 +26,15 @@ the lockstep engine's cache; the step carries the arena with an explicit
 sharding constraint so the jit carry stays sharding-closed (shardlint R2
 — the seeded corpus pair ``slot_cache_carry_drift`` shows the drifted
 form).
+
+``serving.paged`` swaps the contiguous per-slot regions for a
+**block-paged arena** (vLLM / FastGen blocked-KV): a global page pool +
+per-slot page tables traced as int32 vectors, host-side page
+allocation/refcounts/prefix cache in the scheduler, copy-on-write folded
+into the step via a ``cow_src`` vector — same ONE-jitted-step
+discipline, outputs bitwise identical to the contiguous arena (see
+docs/serving.md "Block-paged, prefix-shared arena" and
+tests/test_serving_paged.py).
 """
 
 from __future__ import annotations
@@ -41,7 +50,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..comm.topology import MeshTopology, ParallelDims
 from ..inference.engine import (InferenceEngine, _align_cache,
                                 apply_repetition_penalty, init_inference)
-from ..models.decoding import SCALE_LANES, forward_with_cache, init_cache
+from ..models.decoding import (SCALE_LANES, forward_with_cache, init_cache,
+                               init_paged_cache, paged_cow_copy)
 from ..models.sharding import use_topology
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
@@ -89,27 +99,13 @@ def serving_kv_stream(cfg, max_slots: int, capacity: int,
     }
 
 
-def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
-    """The ONE serving step (pure; jitted by ServingEngine, traced
-    abstractly by the shardlint serving branch).
-
-    Inputs (fixed shapes; N = max_slots, W = token_budget):
-      tokens [N, W] int32   chunk tokens, 0-padded past ``num_new``
-      num_new [N] int32     real tokens per slot (0 = idle slot)
-      start_pos [N] int32   per-slot write frontier (== cached tokens)
-      fresh [N] bool        slot newly allocated → clear its seen row
-      sample_flag [N] bool  slot samples a token this step
-      rng [N, 2] uint32     per-slot PRNG keys (split ONLY when sampling,
-                            mirroring the lockstep engine's chain)
-      temperature/top_p/rep_penalty [N] f32, top_k [N] i32
-
-    Sampling reproduces InferenceEngine._build_decode.sample on a [1, V]
-    row per slot — same masking composition, same categorical key shape —
-    so a slot's tokens match the single-request engine bitwise. The
-    static top_k/top_p gates become traced ``where`` gates (identity
-    branches are bitwise identity), which is what keeps the step at one
-    compile for every sampling mix.
-    """
+def _make_sample_one(vocab: int):
+    """Per-slot sampler reproducing InferenceEngine._build_decode.sample
+    on a [1, V] row — same masking composition, same categorical key
+    shape — so a slot's tokens match the single-request engine bitwise.
+    The static top_k/top_p gates become traced ``where`` gates (identity
+    branches are bitwise identity), which is what keeps the serving step
+    at one compile for every sampling mix."""
 
     def sample_one(row, key, temp, tk, tp_):
         l = row[None, :] / jnp.maximum(temp, 1e-6)
@@ -133,26 +129,72 @@ def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
         sampled = jax.random.categorical(key, l, axis=-1)
         return jnp.where(temp == 0.0, greedy, sampled)[0]
 
-    def advance_rng(key, flag):
-        pair = jax.random.split(key)  # [2, 2]: (sample key, next chain)
-        use = jnp.broadcast_to(flag, key.shape)
-        return (jnp.where(use, pair[0], key),
-                jnp.where(use, pair[1], key))
+    return sample_one
+
+
+def _advance_rng(key, flag):
+    pair = jax.random.split(key)  # [2, 2]: (sample key, next chain)
+    use = jnp.broadcast_to(flag, key.shape)
+    return (jnp.where(use, pair[0], key),
+            jnp.where(use, pair[1], key))
+
+
+def paged_kv_stream(cfg, num_pages: int, page_size: int, max_slots: int,
+                    pages_per_slot: int, token_budget: int,
+                    storage_itemsize: int, quantized: bool,
+                    tp: int = 1) -> Dict[str, Any]:
+    """Analytic per-step HBM traffic of the PAGED serving step, in the
+    shared analytic-streams schema. Upper bound: the per-slot view gather
+    reads every mapped logical page (the Pallas paged kernel's frontier
+    predication reads less), the chunk scatter writes token_budget
+    tokens, and the COW lane copies at most one page per slot. The POOL
+    bytes themselves (the R6 capacity term) are priced from the traced
+    step's invars — num_pages here is reported for the summary line."""
+    per_tok = cfg.kv_heads * cfg.hd * (1 if quantized else storage_itemsize)
+    scale_tok = SCALE_LANES * 4 if quantized else 0
+    view_tokens = cfg.num_layers * max_slots * pages_per_slot * page_size
+    gather = view_tokens * (per_tok + scale_tok) * 2          # k + v reads
+    scatter = cfg.num_layers * max_slots * token_budget * (
+        per_tok + scale_tok
+    ) * 2
+    cow = cfg.num_layers * max_slots * page_size * (per_tok + scale_tok) * 2
+    total = gather + scatter + cow
+    pool_tokens = cfg.num_layers * (num_pages + 1) * page_size
+    return {
+        "kind": "hbm",
+        "bytes_per_step": total,
+        "per_device_bytes_per_step": total // max(tp, 1),
+        "overlapped": False,  # the step's own compute traffic
+        "paged": True,
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "pages_per_slot": pages_per_slot,
+        "pool_bytes": pool_tokens * (per_tok + scale_tok) * 2,
+        "slots": max_slots,
+        "quantized": quantized,
+    }
+
+
+def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
+    """The ONE serving step (pure; jitted by ServingEngine, traced
+    abstractly by the shardlint serving branch).
+
+    Inputs (fixed shapes; N = max_slots, W = token_budget):
+      tokens [N, W] int32   chunk tokens, 0-padded past ``num_new``
+      num_new [N] int32     real tokens per slot (0 = idle slot)
+      start_pos [N] int32   per-slot write frontier (== cached tokens)
+      fresh [N] bool        slot newly allocated → clear its seen row
+      sample_flag [N] bool  slot samples a token this step
+      rng [N, 2] uint32     per-slot PRNG keys (split ONLY when sampling,
+                            mirroring the lockstep engine's chain)
+      temperature/top_p/rep_penalty [N] f32, top_k [N] i32
+    """
+    sample_one = _make_sample_one(vocab)
 
     def step(params, caches, seen, tokens, num_new, start_pos, fresh,
              sample_flag, rng, temperature, top_k, top_p, rep_penalty):
-        N, W = tokens.shape
-        rows = jnp.arange(N)
         live = sample_flag & (num_new > 0)
-        # seen bookkeeping BEFORE the forward, exactly where the lockstep
-        # engine books tokens (prompt before the first sample, each fed
-        # token before its successor samples); fresh slots reset first and
-        # padded positions never book (the ragged-batch hazard fix)
-        seen = jnp.where(fresh[:, None], jnp.zeros_like(seen), seen)
-        valid = jnp.arange(W)[None, :] < num_new[:, None]
-        seen = seen.at[
-            rows[:, None], jnp.clip(tokens, 0, vocab - 1)
-        ].max(valid)
+        seen = _book_seen(seen, tokens, num_new, fresh, vocab)
         logits, caches = forward_with_cache(
             cfg, params, tokens, caches, start_pos, dtype=dtype
         )
@@ -161,19 +203,86 @@ def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
             caches = jax.lax.with_sharding_constraint(
                 caches, cache_shardings
             )
-        # each slot's last REAL token's logits (idle slots read row 0 —
-        # garbage, masked out of sampling by ``live``)
-        idx = jnp.clip(num_new - 1, 0, W - 1)
-        last = jnp.take_along_axis(
-            logits, idx[:, None, None], axis=1
-        )[:, 0]  # [N, V]
-        last = apply_repetition_penalty(
-            last, seen, rep_penalty[:, None], active=live
+        next_tok, new_rng = _sample_tail(
+            sample_one, logits, seen, num_new, live, rng,
+            temperature, top_k, top_p, rep_penalty,
         )
-        keys, new_rng = jax.vmap(advance_rng)(rng, live)
-        next_tok = jax.vmap(sample_one)(
-            last, keys, temperature, top_k, top_p
-        ).astype(jnp.int32)
+        return caches, seen, next_tok, new_rng
+
+    return step
+
+
+def _book_seen(seen, tokens, num_new, fresh, vocab):
+    """seen bookkeeping BEFORE the forward, exactly where the lockstep
+    engine books tokens (prompt before the first sample, each fed token
+    before its successor samples); fresh slots reset first and padded
+    positions never book (the ragged-batch hazard fix)."""
+    N, W = tokens.shape
+    rows = jnp.arange(N)
+    seen = jnp.where(fresh[:, None], jnp.zeros_like(seen), seen)
+    valid = jnp.arange(W)[None, :] < num_new[:, None]
+    return seen.at[
+        rows[:, None], jnp.clip(tokens, 0, vocab - 1)
+    ].max(valid)
+
+
+def _sample_tail(sample_one, logits, seen, num_new, live, rng,
+                 temperature, top_k, top_p, rep_penalty):
+    """Each slot's last REAL token's logits → one sampled token per live
+    slot (idle slots read row 0 — garbage, masked out by ``live``)."""
+    W = logits.shape[1]
+    idx = jnp.clip(num_new - 1, 0, W - 1)
+    last = jnp.take_along_axis(
+        logits, idx[:, None, None], axis=1
+    )[:, 0]  # [N, V]
+    last = apply_repetition_penalty(
+        last, seen, rep_penalty[:, None], active=live
+    )
+    keys, new_rng = jax.vmap(_advance_rng)(rng, live)
+    next_tok = jax.vmap(sample_one)(
+        last, keys, temperature, top_k, top_p
+    ).astype(jnp.int32)
+    return next_tok, new_rng
+
+
+def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
+    """Paged twin of :func:`make_step_fn`: same fixed [N, W] discipline,
+    two extra traced int32 inputs instead of per-slot cache regions —
+
+      page_table [N, max_pages]  physical page per logical page (unmapped
+                                 entries point at the NULL page, where
+                                 idle slots' and chunk tails' padded
+                                 writes land)
+      cow_src [N]                copy-on-write source page (-1 = none):
+                                 a slot diverging from a shared prefix
+                                 mid-page copies that page onto its own
+                                 frontier page BEFORE the chunk write
+
+    Page allocation/free/refcounts live host-side in the scheduler; the
+    step only COPIES (cow), SCATTERS (the chunk) and GATHERS (per-slot
+    views) through the tables, so every arrival/sharing/divergence mix
+    runs the same compiled program — zero recompiles after warmup."""
+    sample_one = _make_sample_one(vocab)
+
+    def step(params, caches, seen, tokens, num_new, start_pos, page_table,
+             cow_src, fresh, sample_flag, rng, temperature, top_k, top_p,
+             rep_penalty):
+        live = sample_flag & (num_new > 0)
+        seen = _book_seen(seen, tokens, num_new, fresh, vocab)
+        caches = paged_cow_copy(caches, page_table, start_pos, cow_src)
+        logits, caches = forward_with_cache(
+            cfg, params, tokens, caches, start_pos, dtype=dtype,
+            page_table=page_table,
+        )
+        if cache_shardings is not None:
+            # keep the donated pool carry sharding-closed across steps
+            caches = jax.lax.with_sharding_constraint(
+                caches, cache_shardings
+            )
+        next_tok, new_rng = _sample_tail(
+            sample_one, logits, seen, num_new, live, rng,
+            temperature, top_k, top_p, rep_penalty,
+        )
         return caches, seen, next_tok, new_rng
 
     return step
@@ -225,10 +334,36 @@ class ServingEngine:
         # per-request cap; the +W margin absorbs the chunk a full slot
         # writes past its frontier (padding rows, never attendable)
         self.max_tokens = min(serving.max_tokens, engine.max_tokens)
-        self.capacity = _align_cache(self.max_tokens + W)
+        self.paged = bool(serving.paged)
+        if self.paged:
+            from ..config import DeepSpeedConfigError
+
+            self.page_size = int(serving.page_size)
+            # logical pages per slot cover max_tokens + the W write margin
+            # (ONE definition of the page math: ServingConfig, fed the
+            # engine-clamped max_tokens)
+            self.pages_per_slot = serving.pages_per_slot(self.max_tokens)
+            self.capacity = self.pages_per_slot * self.page_size
+            self.num_pages = (
+                int(serving.num_pages) or N * self.pages_per_slot
+            )
+            if self.num_pages < self.pages_per_slot:
+                # liveness floor: after evicting everything else, ONE
+                # request must still be able to run to max_tokens —
+                # otherwise forced eviction can never make progress
+                raise DeepSpeedConfigError(
+                    f"serving.num_pages {self.num_pages} is below the "
+                    f"liveness floor ceil((max_tokens + token_budget) / "
+                    f"page_size) = {self.pages_per_slot}; one request "
+                    "could never finish"
+                )
+            self.null_page = self.num_pages  # physical id of the sink page
+        else:
+            self.page_size = self.num_pages = self.pages_per_slot = None
+            self.capacity = _align_cache(self.max_tokens + W)
 
         self.metrics = metrics or ServingMetrics(clock=clock)
-        self.metrics.configure(N)
+        self.metrics.configure(N, num_pages=self.num_pages or 0)
         self.scheduler = Scheduler(
             max_slots=N,
             token_budget=W,
@@ -238,13 +373,24 @@ class ServingEngine:
             max_tokens=self.max_tokens,
             clock=clock,
             metrics=self.metrics,
+            page_size=self.page_size if self.paged else None,
+            num_pages=self.num_pages if self.paged else None,
+            pages_per_slot=self.pages_per_slot if self.paged else None,
+            prefix_cache=bool(serving.prefix_cache) if self.paged else False,
         )
 
-        # ---- the slot KV arena + per-slot sampling state ---------------
-        caches = init_cache(
-            self.config, N, self.capacity, engine.kv_cache_storage_dtype,
-            quantized=engine.kv_cache_quantized,
-        )
+        # ---- the KV arena (contiguous slots, or a paged pool) ----------
+        if self.paged:
+            caches = init_paged_cache(
+                self.config, self.num_pages, self.page_size,
+                engine.kv_cache_storage_dtype,
+                quantized=engine.kv_cache_quantized,
+            )
+        else:
+            caches = init_cache(
+                self.config, N, self.capacity, engine.kv_cache_storage_dtype,
+                quantized=engine.kv_cache_quantized,
+            )
         seen = jnp.zeros((N, self.config.vocab_size), jnp.bool_)
         self._cache_shardings = None
         if self.topology.world_size > 1:
@@ -263,7 +409,8 @@ class ServingEngine:
         self._caches = caches
         self._seen = seen
 
-        step_fn = make_step_fn(
+        make_fn = make_paged_step_fn if self.paged else make_step_fn
+        step_fn = make_fn(
             self.config, self.dtype, self.config.vocab_size,
             cache_shardings=self._cache_shardings,
         )
@@ -276,9 +423,13 @@ class ServingEngine:
             return step_fn(*args)
 
         self._step = jax.jit(counting_step, donate_argnums=(1, 2))
+        arena = (
+            f"pages={self.num_pages}x{self.page_size}tok "
+            f"({self.pages_per_slot}/slot)"
+            if self.paged else f"capacity={self.capacity}/slot"
+        )
         log_dist(
-            f"ServingEngine: slots={N}, token_budget={W}, "
-            f"capacity={self.capacity}/slot, kv="
+            f"ServingEngine: slots={N}, token_budget={W}, {arena}, kv="
             f"{'int8' if engine.kv_cache_quantized else jnp.dtype(engine.kv_cache_storage_dtype).name}, "
             f"tp={self.topology.tp_size}"
         )
@@ -310,25 +461,34 @@ class ServingEngine:
             top_p[w.slot] = req.top_p
             penalty[w.slot] = req.repetition_penalty
             rng[w.slot] = np.asarray(w.state.rng, np.uint32)
-        # rows the plan left idle (num_new == 0) still get a W-wide padded
-        # cache write — repoint it at the DEAD TAIL margin
-        # [capacity - W, capacity), which by construction never holds live
-        # tokens (frontiers stop at max_tokens <= capacity - W). Without
-        # this, an idle ACTIVE slot's row would write garbage at its
-        # plan-default start_pos of 0, clobbering cached prompt K/V the
-        # moment a scheduling policy ever skips a live slot.
-        start_pos = np.where(
-            plan.num_new > 0, plan.start_pos,
-            self.capacity - self.token_budget,
-        ).astype(np.int32)
+        if self.paged:
+            # idle rows need no dead-tail repoint: the scheduler hands
+            # them an all-NULL page-table row, so their padded W-wide
+            # writes land in the NULL sink page by construction
+            start_pos = plan.start_pos
+            paged_args = (jnp.asarray(plan.page_table),
+                          jnp.asarray(plan.cow_src))
+        else:
+            # rows the plan left idle (num_new == 0) still get a W-wide
+            # padded cache write — repoint it at the DEAD TAIL margin
+            # [capacity - W, capacity), which by construction never holds
+            # live tokens (frontiers stop at max_tokens <= capacity - W).
+            # Without this, an idle ACTIVE slot's row would write garbage
+            # at its plan-default start_pos of 0, clobbering cached prompt
+            # K/V the moment a scheduling policy ever skips a live slot.
+            start_pos = np.where(
+                plan.num_new > 0, plan.start_pos,
+                self.capacity - self.token_budget,
+            ).astype(np.int32)
+            paged_args = ()
         with use_topology(self.topology), self.engine._impl_ctx():
             caches, seen, next_tok, new_rng = self._step(
                 self.engine.params, self._caches, self._seen,
                 jnp.asarray(plan.tokens), jnp.asarray(plan.num_new),
-                jnp.asarray(start_pos), jnp.asarray(plan.fresh),
-                jnp.asarray(plan.sample), jnp.asarray(rng),
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-                jnp.asarray(penalty),
+                jnp.asarray(start_pos), *paged_args,
+                jnp.asarray(plan.fresh), jnp.asarray(plan.sample),
+                jnp.asarray(rng), jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p), jnp.asarray(penalty),
             )
         self._caches, self._seen = caches, seen
         finished = self.scheduler.complete(
@@ -364,12 +524,21 @@ class ServingEngine:
             batch=self.max_slots, seq=self.token_budget,
             include_potential=include_potential,
         ))
-        streams["kv_cache"] = serving_kv_stream(
-            self.config, self.max_slots, self.capacity,
-            jnp.dtype(self.engine.kv_cache_storage_dtype).itemsize,
-            self.engine.kv_cache_quantized,
-            tp=self.topology.tp_size,
-        )
+        if self.paged:
+            streams["kv_cache"] = paged_kv_stream(
+                self.config, self.num_pages, self.page_size,
+                self.max_slots, self.pages_per_slot, self.token_budget,
+                jnp.dtype(self.engine.kv_cache_storage_dtype).itemsize,
+                self.engine.kv_cache_quantized,
+                tp=self.topology.tp_size,
+            )
+        else:
+            streams["kv_cache"] = serving_kv_stream(
+                self.config, self.max_slots, self.capacity,
+                jnp.dtype(self.engine.kv_cache_storage_dtype).itemsize,
+                self.engine.kv_cache_quantized,
+                tp=self.topology.tp_size,
+            )
         return streams
 
 
@@ -426,7 +595,26 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         params = jax.tree.map(
             lambda leaf: sds(leaf.shape, leaf.dtype), params_shape
         )
-    cache_shape = init_cache(mcfg, N, capacity, storage, quantized=quantized)
+    paged = bool(srv.paged)
+    if paged:
+        from ..config import DeepSpeedConfigError
+
+        page_size = int(srv.page_size)
+        pages_per_slot = srv.pages_per_slot(max_tokens)
+        num_pages = int(srv.num_pages) or N * pages_per_slot
+        if num_pages < pages_per_slot:
+            raise DeepSpeedConfigError(
+                f"serving.num_pages {num_pages} is below the liveness "
+                f"floor {pages_per_slot} for this model's clamped "
+                "max_tokens; one request could never finish"
+            )
+        cache_shape = init_paged_cache(
+            mcfg, num_pages, page_size, storage, quantized=quantized
+        )
+    else:
+        cache_shape = init_cache(
+            mcfg, N, capacity, storage, quantized=quantized
+        )
     cache_specs = cache_partition_specs(quantized)
     caches = {
         k: sds(v.shape, v.dtype, cache_specs[k])
@@ -436,6 +624,13 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         {k: NamedSharding(mesh, cache_specs[k]) for k in cache_shape}
         if sharded else None
     )
+    paged_args = (
+        (
+            sds((N, pages_per_slot), jnp.int32, P()),  # page_table
+            sds((N,), jnp.int32, P()),                 # cow_src
+        )
+        if paged else ()
+    )
     args = (
         params,
         caches,
@@ -443,6 +638,7 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         sds((N, W), jnp.int32, P()),
         sds((N,), jnp.int32, P()),
         sds((N,), jnp.int32, P()),
+        *paged_args,
         sds((N,), jnp.bool_, P()),
         sds((N,), jnp.bool_, P()),
         sds((N, 2), jnp.uint32, P()),
@@ -451,7 +647,8 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         sds((N,), jnp.float32, P()),
         sds((N,), jnp.float32, P()),
     )
-    step_fn = make_step_fn(mcfg, dtype, V, cache_shardings=cache_shardings)
+    make_fn = make_paged_step_fn if paged else make_step_fn
+    step_fn = make_fn(mcfg, dtype, V, cache_shardings=cache_shardings)
     with use_topology(topology):
         closed = jax.make_jaxpr(step_fn)(*args)
     flat = jax.tree_util.tree_leaves(args)
@@ -462,9 +659,18 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
             s = getattr(leaf, "sharding", None)
             if s is not None:
                 arg_shardings[v] = s
-    streams = {
-        "kv_cache": serving_kv_stream(
-            mcfg, N, capacity, jnp.dtype(storage).itemsize, quantized, tp=tp
-        )
-    }
+    if paged:
+        streams = {
+            "kv_cache": paged_kv_stream(
+                mcfg, num_pages, page_size, N, pages_per_slot, W,
+                jnp.dtype(storage).itemsize, quantized, tp=tp,
+            )
+        }
+    else:
+        streams = {
+            "kv_cache": serving_kv_stream(
+                mcfg, N, capacity, jnp.dtype(storage).itemsize, quantized,
+                tp=tp,
+            )
+        }
     return closed, arg_shardings, streams

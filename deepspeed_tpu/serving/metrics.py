@@ -44,10 +44,24 @@ class ServingMetrics:
         self.steps = 0
         self.tokens_out = 0
         self.scheduled_tokens = 0     # real tokens fed (prefill + decode)
+        # paged arena / prefix cache
+        self.prefix_lookups = 0       # slot admissions that consulted it
+        self.prefix_hits = 0          # admissions with >= 1 cached token
+        self.cached_prompt_tokens = 0  # prompt tokens skipped via cache
+        self.prompt_tokens_seen = 0   # prompt tokens over those lookups
+        self.cow_copies = 0           # in-step copy-on-write page copies
+        self.prefill_chunks = 0       # scheduled prompt chunks (a fully-
+        #   cached prompt's lone final-token feed does not count)
+        self.cached_tail_feeds = 0    # those excluded final-token feeds
         # gauges (last observed)
         self.queue_depth = 0
         self.slot_occupancy = 0.0
+        self.pages_in_use = 0
+        self.pages_free = 0
+        self.arena_utilization = 0.0
+        self.prefix_cache_entries = 0
         self._max_slots = 1
+        self._num_pages = 0
         # per-request samples
         self.ttft_s: List[float] = []
         self.tpot_s: List[float] = []
@@ -91,9 +105,42 @@ class ServingMetrics:
                     (state.finish_t - state.first_token_t) / (n - 1)
                 )
 
+    def on_prefix_lookup(self, cached_tokens: int, prompt_len: int) -> None:
+        self.prefix_lookups += 1
+        self.prompt_tokens_seen += int(prompt_len)
+        if cached_tokens > 0:
+            self.prefix_hits += 1
+            self.cached_prompt_tokens += int(cached_tokens)
+
+    def on_cow(self) -> None:
+        self.cow_copies += 1
+
+    def on_prefill_chunk(self, cached_tail: bool = False) -> None:
+        if cached_tail:
+            self.cached_tail_feeds += 1
+        else:
+            self.prefill_chunks += 1
+
+    def on_pages(self, pool, cache_entries: int = 0) -> None:
+        """Pool gauges from the scheduler's PagePool after a tick."""
+        self.pages_free = pool.free_count
+        self.pages_in_use = pool.num_pages - pool.free_count
+        self.arena_utilization = self.pages_in_use / max(pool.num_pages, 1)
+        self.prefix_cache_entries = int(cache_entries)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Cached prompt tokens over prompt tokens admitted (the token-
+        weighted hit rate; 0.0 before any lookup)."""
+        return (
+            self.cached_prompt_tokens / self.prompt_tokens_seen
+            if self.prompt_tokens_seen else 0.0
+        )
+
     # --------------------------------------------------- engine hooks
-    def configure(self, max_slots: int) -> None:
+    def configure(self, max_slots: int, num_pages: int = 0) -> None:
         self._max_slots = max(int(max_slots), 1)
+        self._num_pages = max(int(num_pages), 0)
 
     def on_step(self) -> None:
         self.steps += 1
@@ -125,6 +172,14 @@ class ServingMetrics:
             "tpot_p50_s": percentile(self.tpot_s, 50),
             "tpot_p95_s": percentile(self.tpot_s, 95),
             "queue_wait_p95_s": percentile(self.queue_wait_s, 95),
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "prefix_hits": self.prefix_hits,
+            "cached_prompt_tokens": self.cached_prompt_tokens,
+            "cow_copies": self.cow_copies,
+            "prefill_chunks": self.prefill_chunks,
+            "pages_in_use": self.pages_in_use,
+            "arena_utilization": self.arena_utilization,
+            "prefix_cache_entries": self.prefix_cache_entries,
         }
 
     def summary(self) -> str:
@@ -145,6 +200,17 @@ class ServingMetrics:
             f"{'gauges':<18}queue_depth={self.queue_depth} "
             f"slot_occupancy={self.slot_occupancy:.2f}",
         ]
+        if self._num_pages:
+            lines.append(
+                f"{'paged arena':<18}pages_in_use={self.pages_in_use}/"
+                f"{self._num_pages} (util {self.arena_utilization:.2f}), "
+                f"prefix hit rate {self.prefix_hit_rate:.2f} "
+                f"({self.prefix_hits}/{self.prefix_lookups} requests, "
+                f"{self.cached_prompt_tokens} tokens), "
+                f"cow_copies={self.cow_copies}, "
+                f"prefill_chunks={self.prefill_chunks} "
+                f"(+{self.cached_tail_feeds} cached-tail feeds)"
+            )
         if self.evict_reasons:
             reasons = ", ".join(
                 f"{k}: {v}" for k, v in sorted(self.evict_reasons.items())

@@ -1,0 +1,230 @@
+"""Host-side KV page management: pool allocator + prefix cache.
+
+Parity: vLLM's PagedAttention block manager / DeepSpeed-FastGen's blocked
+KV cache, host-side only. The device never sees this module — the jitted
+serving step consumes the *result* (per-slot page-table int32 vectors and
+an optional copy-on-write source vector) and keeps its ONE fixed shape.
+
+- :class:`PagePool` — refcounted free-list over ``num_pages`` physical
+  page ids. A page is *live* while any slot or prefix-cache entry holds a
+  reference; ``free + live == num_pages`` is the leak invariant the
+  scheduler asserts after every tick.
+- :class:`PrefixCache` — chained-hash map from token prefixes to pages a
+  finished request left behind. Full pages chain with
+  ``crc32(block_bytes, prev_hash)``; the partial tail page is stored with
+  its valid-token run. Matches verify actual token equality (hash
+  collisions degrade to misses, never to wrong KV). Entries hold one pool
+  reference each; LRU eviction under pool pressure drops that reference,
+  freeing the page once no slot shares it.
+
+Sharing is read-only: a slot whose write frontier lands inside a shared
+page never writes it in place — the scheduler allocates a fresh page and
+the step copies the shared page's KV into it before the chunk write
+(copy-on-write, in-step, fixed shape).
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def chain_hash(prev: int, block) -> int:
+    """Chained block hash: crc32 of the token block seeded by the previous
+    link, so a page's key commits to the ENTIRE prefix before it (KV at a
+    position depends on every earlier token)."""
+    return zlib.crc32(np.asarray(block, np.int32).tobytes(), prev)
+
+
+class PagePool:
+    """Refcounted physical-page allocator (host side, O(1) ops)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"PagePool needs >= 1 page, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.refcount = np.zeros(self.num_pages, np.int64)
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+
+    def alloc(self) -> Optional[int]:
+        """One fresh page with refcount 1, or None when exhausted."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self.refcount[page] = 1
+        return page
+
+    def incref(self, page: int) -> None:
+        if self.refcount[page] <= 0:
+            raise AssertionError(f"incref on dead page {page}")
+        self.refcount[page] += 1
+
+    def decref(self, page: int) -> None:
+        if self.refcount[page] <= 0:
+            raise AssertionError(f"decref on dead page {page}")
+        self.refcount[page] -= 1
+        if self.refcount[page] == 0:
+            self._free.append(page)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_count(self) -> int:
+        return int((self.refcount > 0).sum())
+
+    def check_leaks(self, expected: Optional[Dict[int, int]] = None) -> None:
+        """The leak invariant: ``free + live == num_pages``, and (when the
+        caller supplies its own view) the pool's refcounts match the
+        references the scheduler believes exist, page for page."""
+        if self.free_count + self.live_count != self.num_pages:
+            raise AssertionError(
+                f"page leak: free {self.free_count} + live "
+                f"{self.live_count} != num_pages {self.num_pages}"
+            )
+        if expected is not None:
+            mine = {
+                int(p): int(self.refcount[p])
+                for p in np.nonzero(self.refcount)[0]
+            }
+            if mine != expected:
+                raise AssertionError(
+                    f"page refcount drift: pool {mine} != holders {expected}"
+                )
+
+
+class PrefixCache:
+    """Token-prefix → shared KV pages, refcounted through a PagePool.
+
+    Full pages key on the chain hash of all tokens up to and including the
+    page; the partial tail keys on (chain hash so far, tail token run).
+    ``match`` walks a prompt greedily and returns the shared pages plus
+    how many tokens they cover; the caller caps the hit (a request must
+    always feed at least its final prompt token to sample) and increfs.
+    """
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = int(page_size)
+        # full pages: chain_hash -> (page, block_tuple); tails:
+        # chain_hash -> [(tail_tuple, page), ...]. One LRU order over both
+        # (key -> ("full"|"tail", chain_hash, page, tokens_tuple)).
+        self._full: "OrderedDict[int, Tuple[int, Tuple[int, ...]]]" = (
+            OrderedDict()
+        )
+        self._tails: Dict[int, List[Tuple[Tuple[int, ...], int]]] = {}
+        self._lru: "OrderedDict[Tuple, None]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @property
+    def held_pages(self) -> List[int]:
+        return [key[2] for key in self._lru]
+
+    # ---------------------------------------------------------------- match
+    def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``prompt``: (pages, covered_tokens).
+        Pages are NOT incref'd — the caller takes references for the ones
+        it keeps. Token equality is verified block-for-block."""
+        toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        ps = self.page_size
+        pages: List[int] = []
+        covered = 0
+        h = 0
+        while covered + ps <= len(toks):
+            block = tuple(toks[covered: covered + ps])
+            nh = chain_hash(h, block)
+            entry = self._full.get(nh)
+            if entry is None or entry[1] != block:
+                break
+            pages.append(entry[0])
+            self._lru.move_to_end(("full", nh, entry[0], block))
+            covered += ps
+            h = nh
+        # partial tail: use the stored run's leading tokens that match the
+        # remaining prompt (KV beyond the match is never attendable — the
+        # joining slot's frontier stops at the match)
+        rest = toks[covered:]
+        best: Tuple[int, Tuple[Tuple[int, ...], int]] = (0, None)
+        for tail, page in self._tails.get(h, ()):
+            n = 0
+            for a, b in zip(tail, rest):
+                if a != b:
+                    break
+                n += 1
+            if n > best[0]:
+                best = (n, (tail, page))
+        if best[0] > 0:
+            tail, page = best[1]
+            pages.append(page)
+            self._lru.move_to_end(("tail", h, page, tail))
+            covered += best[0]
+        return pages, covered
+
+    # --------------------------------------------------------------- insert
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Publish a finished request's pages for reuse. ``tokens`` is the
+        run whose KV the pages hold (prompt + generated-but-last);
+        ``pages`` the physical pages covering it in order. Each entry the
+        cache keeps takes ONE pool reference; duplicates of existing
+        entries are skipped (the caller's own references are its business).
+        Returns the number of entries inserted."""
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        ps = self.page_size
+        inserted = 0
+        h = 0
+        full = len(toks) // ps
+        for i in range(full):
+            block = tuple(toks[i * ps: (i + 1) * ps])
+            nh = chain_hash(h, block)
+            if nh not in self._full:
+                self._full[nh] = (int(pages[i]), block)
+                self._lru[("full", nh, int(pages[i]), block)] = None
+                self.pool.incref(int(pages[i]))
+                inserted += 1
+            # ALSO register the full page's run for partial matching: a
+            # prompt diverging mid-page (the shared-system-prompt shape)
+            # still shares this page's leading tokens, copy-on-write at
+            # the divergence point
+            inserted += self._add_tail(h, block, int(pages[i]))
+            h = nh
+        tail = tuple(toks[full * ps:])
+        if tail and full < len(pages):
+            inserted += self._add_tail(h, tail, int(pages[full]))
+        return inserted
+
+    def _add_tail(self, h: int, run: Tuple[int, ...], page: int) -> int:
+        runs = self._tails.setdefault(h, [])
+        if any(existing == run for existing, _ in runs):
+            return 0
+        runs.append((run, page))
+        self._lru[("tail", h, page, run)] = None
+        self.pool.incref(page)
+        return 1
+
+    # --------------------------------------------------------------- evict
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry (its pool reference with it).
+        Returns False when the cache is empty."""
+        if not self._lru:
+            return False
+        key, _ = self._lru.popitem(last=False)
+        kind, h, page, toks = key
+        if kind == "full":
+            self._full.pop(h, None)
+        else:
+            runs = self._tails.get(h, [])
+            self._tails[h] = [r for r in runs if r != (toks, page)]
+            if not self._tails[h]:
+                del self._tails[h]
+        self.pool.decref(page)
+        return True
+
+    def clear(self) -> None:
+        while self.evict_lru():
+            pass

@@ -100,6 +100,13 @@ class RequestState:
     retry_after: Optional[float] = None  # set on eviction
     evict_reason: Optional[str] = None
     rng: Optional[jax.Array] = None  # CURRENT key (advances as tokens sample)
+    # ---- block-paged KV arena (scheduler-owned; empty on the contiguous
+    # arena) ------------------------------------------------------------
+    pages: List[int] = field(default_factory=list)  # physical page per
+    #   logical page, in order; pages[:owned_from] are SHARED (read-only,
+    #   prefix-cache refs) — a write into one triggers copy-on-write
+    owned_from: int = 0          # first logical page this request owns
+    cached_tokens: int = 0       # prompt tokens skipped via the prefix cache
 
     def __post_init__(self):
         if self.rng is None:

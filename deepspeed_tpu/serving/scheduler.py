@@ -31,6 +31,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..utils.logging import log_dist
+from .paging import PagePool, PrefixCache
 from .request import Request, RequestState, RequestStatus
 
 
@@ -53,6 +54,12 @@ class StepPlan:
     start_pos: np.ndarray   # [max_slots] int32 (slot frontier)
     fresh: np.ndarray       # [max_slots] bool (slot newly allocated)
     sample: np.ndarray      # [max_slots] bool
+    # paged arena only (None on the contiguous arena):
+    page_table: Optional[np.ndarray] = None  # [max_slots, pages_per_slot]
+    #   int32 physical page per logical page; unmapped entries (and whole
+    #   idle rows) point at the NULL sink page
+    cow_src: Optional[np.ndarray] = None     # [max_slots] int32 physical
+    #   page to copy-on-write onto the slot's frontier page (-1 = none)
     work: List[ScheduledWork] = field(default_factory=list)
 
     @property
@@ -71,6 +78,10 @@ class Scheduler:
         max_tokens: int = 1024,
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        pages_per_slot: Optional[int] = None,
+        prefix_cache: bool = False,
     ):
         self.max_slots = int(max_slots)
         self.token_budget = int(token_budget)
@@ -86,6 +97,21 @@ class Scheduler:
         self._fresh: set = set()  # slots allocated since their first step
         self._decode_rr = 0  # rotating decode start: fairness when the
                              # token budget cannot cover every decode slot
+        # ---- block-paged arena bookkeeping (host side; the device only
+        # sees the per-step page_table / cow_src int32 vectors) ----------
+        self.paged = page_size is not None
+        if self.paged:
+            self.page_size = int(page_size)
+            self.num_pages = int(num_pages)
+            self.pages_per_slot = int(pages_per_slot)
+            self.null_page = self.num_pages  # physical id of the sink page
+            self.pool = PagePool(self.num_pages)
+            self.prefix_cache = (
+                PrefixCache(self.pool, self.page_size) if prefix_cache
+                else None
+            )
+        else:
+            self.pool = self.prefix_cache = None
 
     # -------------------------------------------------------------- intake
     def submit(self, request: Request) -> RequestState:
@@ -148,18 +174,148 @@ class Scheduler:
         if state.slot is not None:
             self.release(state.slot)
             state.slot = None
+            # mid-flight eviction (page-pool starvation) loses the slot's
+            # KV: restart cleanly on resubmission — progress, generated
+            # tokens and the RNG chain rewind to the request's origin so
+            # a retried request still reproduces its deterministic output
+            state.prompt_pos = 0
+            state.tokens = []
+            state.rng = state.request.rng_key()
+            state.first_token_t = None  # the retry's TTFT is its own
         if self.metrics is not None:
             self.metrics.on_evict(state, now)
         log_dist(f"serving: evicted {state.request.request_id}: {reason}")
         return state
 
     # ------------------------------------------------------------- slots
-    def release(self, slot: int) -> None:
-        """Recycle a slot (its KV range is dead past the next frontier)."""
-        if self.slots[slot] is not None:
+    def release(self, slot: int, *, insert_prefix: bool = False) -> None:
+        """Recycle a slot (its KV range is dead past the next frontier).
+        Paged arena: drop the slot's page references — and, for finished
+        requests (``insert_prefix``), publish its pages to the prefix
+        cache first so identical prompts skip their prefill entirely."""
+        state = self.slots[slot]
+        if state is not None:
             self.slots[slot] = None
             self._free.append(slot)
             self._fresh.discard(slot)
+            if self.paged:
+                self._release_pages(state, insert=insert_prefix)
+
+    # ------------------------------------------------------------- pages
+    def _release_pages(self, state: RequestState, insert: bool) -> None:
+        pages, state.pages = state.pages, []
+        state.owned_from = 0
+        if not pages:
+            return
+        if insert and self.prefix_cache is not None:
+            # KV exists for prompt + generated-but-last (the final sampled
+            # token was never fed back, so its K/V was never written)
+            frontier = state.prompt_len + max(len(state.tokens) - 1, 0)
+            seq = np.concatenate([
+                np.asarray(state.request.prompt, np.int32),
+                np.asarray(state.tokens[:-1], np.int32),
+            ])[:frontier]
+            covered = min(len(seq), len(pages) * self.page_size)
+            self.prefix_cache.insert(seq[:covered], pages)
+        for p in pages:
+            self.pool.decref(p)
+
+    def _attach_prefix(self, state: RequestState) -> None:
+        """Prefix-cache lookup at slot admission: the longest cached
+        prefix becomes shared (refcounted, read-only) pages and its
+        tokens skip prefill. Capped at prompt_len - 1 — a request must
+        always feed its final prompt token to sample the first output, so
+        a full-prompt hit enters decode with ONE single-token feed (and a
+        copy-on-write of the shared tail page) instead of prefill
+        chunks."""
+        state.pages = []
+        state.owned_from = 0
+        state.cached_tokens = 0
+        if self.prefix_cache is None:
+            return
+        if state.request.repetition_penalty != 1.0:
+            # the repetition-penalty ``seen`` matrix is built from FED
+            # tokens; a cache hit skips feeding the cached prompt, so a
+            # penalized request's sampling would depend on cache warmth.
+            # Penalized requests therefore always prefill — correctness
+            # (bitwise parity with the single-request oracle) over reuse.
+            return
+        pages, covered = self.prefix_cache.match(state.request.prompt)
+        covered = min(covered, state.prompt_len - 1)
+        npages = -(-covered // self.page_size) if covered > 0 else 0
+        pages = pages[:npages]
+        for p in pages:
+            self.pool.incref(p)
+        state.pages = list(pages)
+        state.owned_from = len(pages)
+        state.cached_tokens = covered
+        state.prompt_pos = covered
+        if self.metrics is not None:
+            self.metrics.on_prefix_lookup(covered, state.prompt_len)
+
+    def _alloc_page(self) -> Optional[int]:
+        """One fresh page, evicting LRU prefix-cache entries under
+        pressure; None when the pool is truly exhausted."""
+        p = self.pool.alloc()
+        while p is None and self.prefix_cache is not None \
+                and self.prefix_cache.evict_lru():
+            p = self.pool.alloc()
+        return p
+
+    def _prepare_pages(self, state: RequestState, start: int,
+                       n: int) -> tuple:
+        """Make [start, start + n) writable for one slot: allocate fresh
+        pages covering the span and copy-on-write the frontier page when
+        it is shared. Returns ``(n_writable, cow_src)`` — pool pressure
+        may shrink the chunk (0 = skip the slot this step); ``cow_src``
+        is the physical page the step must copy onto the slot's frontier
+        page, or -1."""
+        ps = self.page_size
+        need = min(-(-(start + n) // ps), self.pages_per_slot)
+        while len(state.pages) < need:
+            p = self._alloc_page()
+            if p is None:
+                break
+            state.pages.append(p)
+        n = min(n, len(state.pages) * ps - start)
+        if n <= 0:
+            return 0, -1
+        cow = -1
+        fp = start // ps
+        if fp < state.owned_from:
+            # the write frontier sits inside a shared page: divergence.
+            # Remap to a fresh page; the step copies the shared page's KV
+            # onto it BEFORE the chunk write. Decref-ing the shared page
+            # immediately is safe even if it frees: the step's COW gather
+            # reads pre-step pool content, and any new owner's writes land
+            # in the later scatter phase.
+            newp = self._alloc_page()
+            if newp is None:
+                return 0, -1
+            cow = state.pages[fp]
+            state.pages[fp] = newp
+            state.owned_from = fp
+            self.pool.decref(cow)
+            if self.metrics is not None:
+                self.metrics.on_cow()
+        return n, cow
+
+    def assert_page_invariants(self) -> None:
+        """The leak invariant after every tick: ``free + live ==
+        num_pages``, and every live page's refcount equals exactly the
+        slot + prefix-cache references the scheduler knows about."""
+        if not self.paged:
+            return
+        expected: dict = {}
+        for st in self.slots:
+            if st is None:
+                continue
+            for p in st.pages:
+                expected[p] = expected.get(p, 0) + 1
+        if self.prefix_cache is not None:
+            for p in self.prefix_cache.held_pages:
+                expected[p] = expected.get(p, 0) + 1
+        self.pool.check_leaks(expected)
 
     def evict_timeouts(self) -> List[RequestState]:
         """Evict queued requests that waited past request_timeout_s."""
@@ -179,6 +335,8 @@ class Scheduler:
             state.prefill_start_t = now
             self.slots[slot] = state
             self._fresh.add(slot)
+            if self.paged:
+                self._attach_prefix(state)
             if self.metrics is not None:
                 self.metrics.on_admit(state, now,
                                       queue_depth=len(self.queue))
@@ -197,6 +355,34 @@ class Scheduler:
         now = self.clock()
         self.evict_timeouts()
         self._admit_to_slots(now)
+        plan = self._build_plan()
+        # paged arena: an empty plan while slots are live means page-pool
+        # starvation (a live slot always schedules otherwise). Evict the
+        # NEWEST in-flight request — gracefully, it can resubmit after
+        # backoff — and retry, so the oldest requests always finish. The
+        # config floor num_pages >= pages_per_slot makes this terminate
+        # with at least one schedulable request.
+        while plan is None and self.paged and self.active_count > 0:
+            victim = max(
+                (s for s in self.slots if s is not None),
+                key=lambda s: (s.prefill_start_t or 0.0, s.slot),
+            )
+            self._evict(victim, now, "page pool exhausted")
+            self._admit_to_slots(now)
+            plan = self._build_plan()
+        if self.paged:
+            self.assert_page_invariants()
+            if self.metrics is not None:
+                self.metrics.on_pages(
+                    self.pool,
+                    len(self.prefix_cache) if self.prefix_cache else 0,
+                )
+        if plan is not None and self.metrics is not None:
+            self.metrics.on_plan(plan, now, queue_depth=len(self.queue),
+                                 occupancy=self.active_count)
+        return plan
+
+    def _build_plan(self) -> Optional[StepPlan]:
         N, W = self.max_slots, self.token_budget
         plan = StepPlan(
             tokens=np.zeros((N, W), np.int32),
@@ -204,6 +390,11 @@ class Scheduler:
             start_pos=np.zeros(N, np.int32),
             fresh=np.zeros(N, np.bool_),
             sample=np.zeros(N, np.bool_),
+            page_table=(
+                np.full((N, self.pages_per_slot), self.null_page, np.int32)
+                if self.paged else None
+            ),
+            cow_src=np.full(N, -1, np.int32) if self.paged else None,
         )
         budget = W
         # decodes first: latency-critical, one token each. The scan starts
@@ -219,10 +410,18 @@ class Scheduler:
                 break
             tok = state.tokens[-1]
             pos = state.prompt_len + len(state.tokens) - 1
+            cow = -1
+            if self.paged:
+                ok, cow = self._prepare_pages(state, pos, 1)
+                if ok < 1:
+                    continue  # page pressure: this decode waits a step
             plan.tokens[slot, 0] = tok
             plan.num_new[slot] = 1
             plan.start_pos[slot] = pos
             plan.sample[slot] = True
+            if self.paged:
+                plan.cow_src[slot] = cow
+                plan.page_table[slot, :len(state.pages)] = state.pages
             plan.work.append(ScheduledWork(slot, state, 1, True))
             budget -= 1
         self._decode_rr = (self._decode_rr + 1) % N
@@ -240,6 +439,11 @@ class Scheduler:
                 break
             chunk = min(budget, state.prompt_remaining, W)
             lo = state.prompt_pos
+            cow = -1
+            if self.paged:
+                chunk, cow = self._prepare_pages(state, lo, chunk)
+                if chunk < 1:
+                    continue  # page pressure: the prompt waits a step
             plan.tokens[slot, :chunk] = state.request.prompt[lo: lo + chunk]
             plan.num_new[slot] = chunk
             plan.start_pos[slot] = lo
@@ -247,17 +451,27 @@ class Scheduler:
             plan.sample[slot] = final
             plan.fresh[slot] = slot in self._fresh
             self._fresh.discard(slot)
+            if self.paged:
+                plan.cow_src[slot] = cow
+                plan.page_table[slot, :len(state.pages)] = state.pages
+            if self.metrics is not None:
+                # a fully-cached prompt's only feed is its final token
+                # (the sampling feed) — that is NOT a prefill chunk
+                self.metrics.on_prefill_chunk(
+                    cached_tail=(
+                        state.cached_tokens >= state.prompt_len - 1
+                        and lo == state.prompt_len - 1
+                    ),
+                )
             plan.work.append(ScheduledWork(slot, state, chunk, final))
             budget -= chunk
         # inactive slots keep num_new=0 and start_pos=0; the ENGINE
         # repoints their padded W-wide cache write at the dead tail
-        # margin (ServingEngine._run_plan), so an idle-but-active slot
-        # never clobbers its own cached tokens
+        # margin (ServingEngine._run_plan) — or, paged, their all-NULL
+        # page-table row sinks it — so an idle-but-active slot never
+        # clobbers its own cached tokens
         if not plan.work:
             return None
-        if self.metrics is not None:
-            self.metrics.on_plan(plan, now, queue_depth=len(self.queue),
-                                 occupancy=self.active_count)
         return plan
 
     # ---------------------------------------------------------- complete
@@ -287,10 +501,14 @@ class Scheduler:
             if hit_eos or len(st.tokens) >= req.max_new_tokens:
                 st.transition(RequestStatus.DONE)
                 st.finish_t = now
-                self.release(st.slot)
+                # finished requests publish their pages to the prefix
+                # cache (paged arena) before the slot recycles
+                self.release(st.slot, insert_prefix=True)
                 finished.append(st)
             if self.metrics is not None:
                 self.metrics.on_token(st, now)
+        if self.paged:
+            self.assert_page_invariants()
         if self.metrics is not None:
             for st in finished:
                 self.metrics.on_finish(st, now)

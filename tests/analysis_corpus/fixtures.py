@@ -6,6 +6,8 @@ trace-ready evidence of one statically-visible bug class:
 - ``stacked_dim0_drift``    R2: the PR-1 bucketed-opt carry drift
 - ``slot_cache_carry_drift`` R2: a serving slot-KV arena whose step
   carry re-puts the head partition onto the slot dim
+- ``paged_pool_carry_drift`` R2: the block-paged pool carry (gather/
+  scatter through a page table) whose write-back sharding drifts
 - ``missing_psum_grads``    R1: dp-local grads applied as if reduced
 - ``broken_ppermute_ring``  R3: a pipeline ring with a stray edge
 - ``read_after_donate``     R4: a rotating slot read after overwrite
@@ -106,6 +108,49 @@ def slot_cache_carry_drift():
 def slot_cache_carry_drift_clean():
     mesh = corpus_mesh()
     return _slot_cache_scan(mesh, False), {"mesh": mesh}, "R2"
+
+
+# ------------------------------------------------------------------ R2 ter
+def _paged_pool_scan(mesh, drift: bool):
+    """The PAGED serving arena's pool carry: a global page pool
+    [num_pages, page_size, kv*hd] resting with cache heads over tp,
+    addressed through a traced per-slot page table (gather for the
+    per-slot views, scatter for the chunk write — the block-paged form of
+    the slot arena). The drifted form re-puts the carried pool with the
+    head partition moved onto the PAGE dim — the bug a paged step whose
+    pool write-back loses its sharding constraint compiles to: the whole
+    pool reshards over ICI every serving step."""
+    resting = NamedSharding(mesh, P(None, None, "tp"))
+    writeback = NamedSharding(
+        mesh, P("dp", None, None) if drift else P(None, None, "tp")
+    )
+
+    def step(pool, page_table):
+        pool = lax.with_sharding_constraint(pool, resting)
+
+        def body(c, _):
+            view = c[page_table]          # [slots, pages/slot, ps, kv*hd]
+            chunk = view[:, 0, :2] + 1.0  # one step's per-slot writes
+            c = c.at[page_table[:, 0], :2].set(chunk)
+            c = jax.device_put(c, writeback)  # the step's carry-out
+            return c, ()
+
+        y, _ = lax.scan(body, pool, None, length=3)
+        return y
+
+    pool = jax.ShapeDtypeStruct((8, 4, 16), jnp.float32)
+    pt = jnp.zeros((2, 3), jnp.int32)
+    return jax.make_jaxpr(step)(pool, pt)
+
+
+def paged_pool_carry_drift():
+    mesh = corpus_mesh()
+    return _paged_pool_scan(mesh, True), {"mesh": mesh}, "R2"
+
+
+def paged_pool_carry_drift_clean():
+    mesh = corpus_mesh()
+    return _paged_pool_scan(mesh, False), {"mesh": mesh}, "R2"
 
 
 # --------------------------------------------------------------------- R1
@@ -416,6 +461,7 @@ def unhideable_offload_stream_clean():
 HAZARDS = [
     stacked_dim0_drift,
     slot_cache_carry_drift,
+    paged_pool_carry_drift,
     missing_psum_grads,
     broken_ppermute_ring,
     read_after_donate,
@@ -430,6 +476,7 @@ HAZARDS = [
 CLEAN_TWINS = [
     stacked_dim0_drift_clean,
     slot_cache_carry_drift_clean,
+    paged_pool_carry_drift_clean,
     missing_psum_grads_clean,
     broken_ppermute_ring_clean,
     read_after_donate_clean,

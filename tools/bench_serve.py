@@ -7,6 +7,10 @@ continuous-batching serving engine on a CPU mesh.
     python tools/bench_serve.py --check-recompiles   # CI gate: exit 1 if
                                                      # the slot step traced
                                                      # more than once
+    python tools/bench_serve.py --paged --system-prompt 24  # block-paged
+                                                     # arena + prefix-heavy
+                                                     # trace (one shared
+                                                     # system prompt)
 
 Arrivals land on a VIRTUAL clock (exponential inter-arrival gaps at
 ``--rate`` requests/s); each engine step advances the clock by its
@@ -57,11 +61,19 @@ def build_trace(args):
     r = np.random.RandomState(args.seed)
     gaps = r.exponential(1.0 / args.rate, size=args.requests)
     arrivals = np.cumsum(gaps)
+    # prefix-heavy traffic: every request opens with the SAME system
+    # prompt (the "millions of users hitting one assistant prompt" shape
+    # the prefix cache exists for)
+    system = (
+        r.randint(0, args.vocab, size=(args.system_prompt,))
+        if args.system_prompt > 0 else np.zeros((0,), np.int64)
+    )
     trace = []
     for i in range(args.requests):
         plen = int(r.randint(args.min_prompt, args.max_prompt + 1))
         new = int(r.randint(args.min_new, args.max_new + 1))
-        prompt = r.randint(0, args.vocab, size=(plen,))
+        user = r.randint(0, args.vocab, size=(plen,))
+        prompt = np.concatenate([system, user])
         trace.append((float(arrivals[i]), f"req-{i}", prompt, new))
     return trace
 
@@ -88,6 +100,20 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--check-recompiles", action="store_true",
                     help="exit 1 unless the slot step compiled exactly once")
+    ap.add_argument("--paged", action="store_true",
+                    help="block-paged KV arena (page pool + per-slot page "
+                         "tables + prefix cache) instead of contiguous "
+                         "slot regions")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="tokens per KV page (--paged)")
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="physical page-pool size; 0 = auto "
+                         "(slots * pages_per_slot, no overcommit)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable prefix sharing in --paged mode")
+    ap.add_argument("--system-prompt", type=int, default=0, metavar="LEN",
+                    help="prepend one shared LEN-token system prompt to "
+                         "every request (prefix-heavy trace)")
     args = ap.parse_args(argv)
 
     import jax
@@ -126,6 +152,10 @@ def main(argv=None) -> int:
             "queue_limit": max(args.requests, 1),
             "request_timeout_s": 1e9,  # the replay never times out
             "max_tokens": 64,
+            "paged": args.paged,
+            "page_size": args.page_size,
+            "num_pages": args.num_pages,
+            "prefix_cache": not args.no_prefix_cache,
         },
     )
     trace = build_trace(args)
@@ -161,6 +191,15 @@ def main(argv=None) -> int:
         f"{m['ttft_p95_s'] * 1e3:.1f} ms, p50/p95 TPOT = "
         f"{m['tpot_p50_s'] * 1e3:.1f}/{m['tpot_p95_s'] * 1e3:.1f} ms"
     )
+    if args.paged:
+        print(
+            f"paged arena: {srv.num_pages} pages x {srv.page_size} tok "
+            f"({srv.pages_per_slot}/slot), pages_in_use={m['pages_in_use']} "
+            f"(util {m['arena_utilization']:.2f}), prefix hit rate "
+            f"{m['prefix_hit_rate']:.2f} ({m['cached_prompt_tokens']} cached "
+            f"prompt tokens), cow_copies={m['cow_copies']}, "
+            f"prefill_chunks={m['prefill_chunks']}"
+        )
     print(
         f"recompiles: serving step traces={srv.step_traces} "
         f"(zero-after-warmup criterion: 1), lockstep engine compiles="
