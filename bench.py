@@ -298,6 +298,27 @@ def lint_targets(dp: int):
     ]
 
 
+def autotune_rung_targets(dp: int):
+    """(name, model, ds_config) for representative autotuner ladder
+    rungs, appended to ``shardlint --all-examples`` (ISSUE 7): the
+    planner-driven search measures only statically-clean rungs, so the
+    rungs themselves must stay lintable. Two rungs that differ from the
+    bench legs already gated: a mid-ladder ZeRO-2 remat rung and the
+    deepest ladder rung (stage 3 + cpu offload at max remat, the phase-0
+    escalation endpoint)."""
+    model_410m, B, _S = bench_model(smoke=False, tag="410m")
+    B = -(-B // dp) * dp
+    micro = max(B // dp, 1)
+    return [
+        ("autotune-rung-z2-dots_flash", model_410m,
+         make_ds_config(B, {"stage": 2}, "dots_flash", micro, {})),
+        ("autotune-rung-z3off-full", model_410m,
+         make_ds_config(B, {"stage": 3,
+                            "offload_optimizer": {"device": "cpu"}},
+                        "full", 1, {})),
+    ]
+
+
 def time_chained_steps(engine, data, chain: int = 5, trials: int = 3) -> float:
     """Median per-step seconds over chained-dispatch trials (one compile,
     one readback per trial — the steady-state shape the records compare)."""
@@ -342,12 +363,15 @@ def offload_report(engine, step_s: float):
     }
 
 
-def plan_summary(engine, name: str, measured_step_s=None):
+def plan_summary(engine, name: str, measured_step_s=None,
+                 bank_drift=True):
     """The analysis/cost planner's budget for the running engine — same
     table `tools/shardplan.py` and `shardlint --report` print, so every
     BENCH run banks the predicted-vs-measured step pair (the planner's
-    roofline vs the wall clock). Best-effort: a bench number must never
-    die on its accounting line."""
+    roofline vs the wall clock) into the persistent drift ledger
+    (perf/drift.jsonl; analysis/cost/drift.py). Systematic drift
+    surfaces here as a recalibration suggestion for cost/hardware.py.
+    Best-effort: a bench number must never die on its accounting line."""
     try:
         from deepspeed_tpu.analysis import format_plan_table, plan_engine
 
@@ -362,6 +386,30 @@ def plan_summary(engine, name: str, measured_step_s=None):
         }
         if measured_step_s:
             out["vs_measured"] = round(plan.est_step_s / measured_step_s, 4)
+        if measured_step_s and bank_drift:
+            try:
+                from deepspeed_tpu.analysis.cost import drift
+
+                ledger = drift.DriftLedger(
+                    os.path.join(REPO_DIR, "perf", "drift.jsonl")
+                )
+                entry = drift.make_entry(plan, measured_step_s, source=name)
+                ledger.append(entry)
+                lo, hi = drift.band_for(plan.hardware.gen)
+                out["drift"] = {
+                    "ratio": entry["ratio"],
+                    "band": [round(lo, 4), round(hi, 4)],
+                    "ok": bool(entry["ratio"] and lo <= entry["ratio"] <= hi),
+                }
+                recal = drift.recalibration_suggestion(
+                    ledger.load(gen=plan.hardware.gen)
+                )
+                if recal:
+                    out["drift"]["recalibration"] = recal
+                    print(f"bench: {recal}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — ledger is evidence,
+                # never a reason to lose the bench number
+                print(f"bench: drift ledger skipped: {e}", file=sys.stderr)
         return out
     except Exception as e:  # noqa: BLE001
         print(f"bench: plan_summary failed: "
@@ -527,8 +575,11 @@ def main():
     # relay RPC before each dispatch (a real input pipeline prefetches).
     dt = time_chained_steps(engine, data)
     offload = offload_report(engine, dt)
-    # price the MEASURED engine before any A/B rebuild swaps it out
-    plan = plan_summary(engine, f"bench-{model_tag()}", measured_step_s=dt)
+    # price the MEASURED engine before any A/B rebuild swaps it out.
+    # Smoke runs skip the drift ledger: the tiny validation model is
+    # dispatch-dominated, its ratio would only pollute the evidence.
+    plan = plan_summary(engine, f"bench-{model_tag()}", measured_step_s=dt,
+                        bank_drift=not smoke)
     if offload is not None and os.environ.get("BENCH_OFFLOAD_AB") and big:
         # A/B the double-buffer knob in the same window: rebuild the
         # engine (the 1.5B state doesn't fit twice) with the knob flipped

@@ -8,7 +8,15 @@ plus an ICI/FLOPs/HBM roofline step estimate. Rules R6 (capacity) and
 R8 (overlap-budget) consume it; ``tools/shardplan.py`` is the CLI.
 """
 
-from .hardware import HardwareModel
+from .drift import (
+    DriftLedger,
+    band_for,
+    check as drift_check,
+    make_entry as drift_entry,
+    recalibration_suggestion,
+    summarize as drift_summary,
+)
+from .hardware import HardwareModel, gen_defaults
 from .pipeline import (
     auto_chunk,
     boundary_bytes,
@@ -23,24 +31,33 @@ from .planner import (
     plan_engine,
     plan_for_context,
     plan_jaxpr,
+    scale_plan_micro,
 )
 from .walk import JaxprWalker, WalkStats, device_bytes, dimspec_from_sharding
 
 __all__ = [
+    "DriftLedger",
     "HardwareModel",
     "JaxprWalker",
     "Plan",
     "WalkStats",
     "auto_chunk",
+    "band_for",
     "boundary_bytes",
     "device_bytes",
     "dimspec_from_sharding",
+    "drift_check",
+    "drift_entry",
+    "drift_summary",
     "format_plan_table",
+    "gen_defaults",
     "growth_per_microbatch",
     "pipeline_temp_bytes",
     "plan_config",
     "plan_engine",
     "plan_for_context",
     "plan_jaxpr",
+    "recalibration_suggestion",
+    "scale_plan_micro",
     "stash_boundaries",
 ]

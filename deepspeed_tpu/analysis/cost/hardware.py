@@ -4,30 +4,66 @@ One dataclass, per-TPU-generation defaults (same table bench.py uses for
 MFU), env-var overrides shared with the bench legs so a BENCH run and its
 shardplan prediction price the same machine:
 
-- ``PALLAS_AXON_TPU_GEN``    chip generation ("v4"/"v5e"/"v5p"/"v6e")
+- ``PALLAS_AXON_TPU_GEN``    chip generation ("v4"/"v5e"/"v5p"/"v6e",
+                             or "cpu" for the host-mesh envelope)
 - ``BENCH_HOST_BW_GBS``      host<->HBM DMA link, GB/s (offload stream)
 - ``BENCH_ICI_BW_GBS``       per-link ICI bandwidth, GB/s (ring hops)
 - ``SHARDPLAN_HBM_GB``       per-device HBM capacity budget override
 
 Everything is per *device*: the planner's byte and flop counts are
 per-device too, so seconds fall straight out.
+
+When no generation is pinned and the active jax backend is the CPU (the
+lint/test/CI mesh), detection falls back to the ``cpu`` row — a
+deliberately rough envelope of one virtual host device on a shared
+8-device mesh, calibrated against measured 410M-family steps so the
+drift ledger (:mod:`.drift`) compares a CPU prediction with a CPU wall
+clock instead of pricing the host like a v5e.
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Dict, Tuple
 
 _GIB = float(1 << 30)
 
 # (bf16 peak flops, HBM bytes, HBM GB/s) per generation. Peaks match
 # bench.peak_flops_per_chip; HBM bandwidth is the published spec number.
+# The "cpu" row is the virtual-host-device envelope: ~3 GF/s effective
+# per device on a contended 8-device host mesh (measured, see
+# docs/autotuning.md "Drift bands"), 16 GiB as a neutral budget column.
 _GEN_TABLE = {
     "v4": (275e12, 32 * _GIB, 1228e9),
     "v5e": (197e12, 16 * _GIB, 819e9),
     "v5p": (459e12, 95 * _GIB, 2765e9),
     "v6e": (918e12, 32 * _GIB, 1640e9),
+    "cpu": (3e9, 16 * _GIB, 3e9),
 }
+
+# per-generation (ici GB/s, host-DMA GB/s) defaults when the bench env
+# overrides are unset; TPU gens share the historical 45/32 numbers
+_LINK_TABLE = {"cpu": (1.0, 3.0)}
+_LINK_DEFAULT = (45.0, 32.0)
+
+
+def gen_defaults(gen: str) -> Dict[str, float]:
+    """The raw table row for one generation (the constants the drift
+    ledger's recalibration suggestion talks about)."""
+    flops, hbm, hbm_bw = _GEN_TABLE.get(gen, _GEN_TABLE["v5e"])
+    ici, host = _LINK_TABLE.get(gen, _LINK_DEFAULT)
+    return {"peak_flops": flops, "hbm_bytes": hbm, "hbm_bw": hbm_bw,
+            "ici_bw": ici * 1e9, "host_bw": host * 1e9}
+
+
+def _local_backend_is_cpu() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 — backend not initialisable here
+        return False
 
 
 @dataclass
@@ -43,17 +79,27 @@ class HardwareModel:
 
     @classmethod
     def detect(cls) -> "HardwareModel":
-        """Defaults for the local generation + the bench env overrides."""
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        flops, hbm, hbm_bw = _GEN_TABLE.get(gen, _GEN_TABLE["v5e"])
+        """Defaults for the local generation + the bench env overrides.
+
+        ``PALLAS_AXON_TPU_GEN`` pins the generation; otherwise a live
+        CPU backend selects the ``cpu`` envelope (so lint-mesh plans and
+        drift checks price the machine that actually runs them) and
+        anything else keeps the historical v5e default."""
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+        if not gen:
+            gen = "cpu" if _local_backend_is_cpu() else "v5e"
+        d = gen_defaults(gen)
+        hbm = d["hbm_bytes"]
         hbm_gb = os.environ.get("SHARDPLAN_HBM_GB")
         if hbm_gb:
             hbm = float(hbm_gb) * _GIB
+        ici_env = os.environ.get("BENCH_ICI_BW_GBS")
+        host_env = os.environ.get("BENCH_HOST_BW_GBS")
         return cls(
             gen=gen,
-            peak_flops=flops,
+            peak_flops=d["peak_flops"],
             hbm_bytes=hbm,
-            hbm_bw=hbm_bw,
-            ici_bw=float(os.environ.get("BENCH_ICI_BW_GBS", 45)) * 1e9,
-            host_bw=float(os.environ.get("BENCH_HOST_BW_GBS", 32)) * 1e9,
+            hbm_bw=d["hbm_bw"],
+            ici_bw=float(ici_env) * 1e9 if ici_env else d["ici_bw"],
+            host_bw=float(host_env) * 1e9 if host_env else d["host_bw"],
         )

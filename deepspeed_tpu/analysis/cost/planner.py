@@ -254,6 +254,45 @@ def plan_jaxpr(
     return plan
 
 
+def scale_plan_micro(plan: Plan, factor: float,
+                     source: Optional[str] = None) -> Plan:
+    """Derive a larger-micro-batch Plan from a traced one by scaling the
+    batch-linear terms (activation live set, flops, HBM traffic, ICI
+    payloads) by ``factor`` while state bytes stay fixed.
+
+    This is the autotuner's memoized fast-prune path: once micro=m at a
+    (stage, remat) rung is statically over budget, every larger micro at
+    the same rung is *at least* this plan scaled up — deriving it skips
+    a second abstract trace, and the direction of every approximation
+    (grad-reduce ICI does not actually grow with micro, collective
+    scratch is held) only matters for rungs that are already doomed.
+    Rank-bearing survivors are always traced, never scaled."""
+    from dataclasses import replace
+
+    f = float(factor)
+    scaled = replace(
+        plan,
+        source=source or f"{plan.source} (x{f:g} micro, derived)",
+        act_peak_bytes=plan.act_peak_bytes * f,
+        peak_hbm_bytes=plan.peak_hbm_bytes
+        + plan.act_peak_bytes * (f - 1.0),
+        flops=plan.flops * f,
+        hbm_traffic_bytes=plan.hbm_traffic_bytes * f,
+        ici_bytes={k: v * f for k, v in plan.ici_bytes.items()},
+        ici_hops=dict(plan.ici_hops),
+        streams=dict(plan.streams),
+        seconds=0.0,
+    )
+    hw = scaled.hardware
+    scaled.compute_s = scaled.flops / hw.peak_flops if hw.peak_flops else 0.0
+    scaled.hbm_s = scaled.hbm_traffic_bytes / hw.hbm_bw if hw.hbm_bw else 0.0
+    scaled.ici_s = max(
+        (b / hw.ici_bw for b in scaled.ici_bytes.values()), default=0.0
+    ) if hw.ici_bw else 0.0
+    scaled.est_step_s = max(scaled.compute_s, scaled.hbm_s, scaled.ici_s)
+    return scaled
+
+
 def plan_for_context(ctx) -> Plan:
     """The plan for one LintContext (cached on the context — R6 and R8
     share a single walk)."""

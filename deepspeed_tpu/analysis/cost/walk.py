@@ -75,6 +75,21 @@ _MATERIALIZING = {
     "argmax", "argmin", "cumsum", "cumlogsumexp", "concatenate",
 } | set(_COLLECTIVES)
 
+# reduction-family consumers XLA fuses INTO their producer (loop/epilogue
+# fusion): a single-use intermediate between a fusable producer and one
+# of these never materializes — charging both the producer's write and
+# the consumer's read double-counted it (the documented PR-4 bias)
+_FUSABLE_REDUCERS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "argmax", "argmin", "cumsum", "cumlogsumexp",
+}
+# producers whose output an elementwise+reduce consumer fuses onto;
+# collectives and scatter-family writes keep their charges (their outputs
+# come out of dedicated buffers the consumer really reads back)
+_FUSABLE_PRODUCERS = _MATERIALIZING - set(_COLLECTIVES) - {
+    "scatter", "scatter-add", "dynamic_update_slice", "sort",
+}
+
 
 def _itemsize(dtype) -> float:
     try:
@@ -157,6 +172,83 @@ class WalkStats:
         self.collective_scratch = max(
             self.collective_scratch, other.collective_scratch
         )
+
+
+@dataclass
+class _Fusion:
+    """Per-level producer-consumer coalescing evidence.
+
+    ``reads[v]`` — v is a reducer operand whose read is fused with its
+    producer chain: charge the chain root instead (or nothing when the
+    root itself fuses away). ``outs`` — values a fusable producer never
+    writes back to HBM (their only consumer is a fused reducer)."""
+
+    reads: Dict[Any, Any] = field(default_factory=dict)
+    outs: set = field(default_factory=set)
+
+
+def _chain_link(eqn) -> bool:
+    """True when ``eqn`` is a pure elementwise link a fused reducer reads
+    *through*: exactly one non-literal input, no nested jaxpr, and not a
+    primitive that materializes on its own."""
+    if eqn.primitive.name in _MATERIALIZING:
+        return False
+    if any(k in eqn.params for k in _CALL_KEYS) or eqn.primitive.name in (
+        "scan", "while", "cond", "shard_map"
+    ):
+        return False
+    return sum(1 for a in eqn.invars if not isinstance(a, Literal)) == 1
+
+
+def analyze_fusion(jaxpr: Jaxpr) -> _Fusion:
+    """Coalesce producer→elementwise-chain→reducer triples at one jaxpr
+    level. XLA fuses a reduction-family consumer into its producer when
+    the intermediate is single-use, so the bytes between them never move
+    through HBM; without this credit the walk charged the producer's
+    write AND the consumer's read of the same value."""
+    use_count: Dict[Any, int] = {}
+    producer: Dict[Any, Any] = {}
+    for eqn in jaxpr.eqns:
+        for a in eqn.invars:
+            if not isinstance(a, Literal):
+                use_count[a] = use_count.get(a, 0) + 1
+        for ov in eqn.outvars:
+            producer[ov] = eqn
+    for a in jaxpr.outvars:
+        if not isinstance(a, Literal):
+            # a level output materializes for the caller regardless
+            use_count[a] = use_count.get(a, 0) + 1
+
+    fusion = _Fusion()
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name not in _FUSABLE_REDUCERS:
+            continue
+        for v in eqn.invars:
+            if isinstance(v, Literal) or use_count.get(v, 0) != 1:
+                continue
+            # walk back through single-use elementwise links to the root
+            root = v
+            while True:
+                p = producer.get(root)
+                if p is None or not _chain_link(p):
+                    break
+                root = next(a for a in p.invars
+                            if not isinstance(a, Literal))
+                if use_count.get(root, 0) != 1:
+                    break  # multi-use root still materializes; it is the
+                    # redirect target, not another link to walk through
+            p = producer.get(root)
+            if (p is not None and p.primitive.name in _FUSABLE_PRODUCERS
+                    and use_count.get(root, 0) == 1):
+                # the whole triple fuses: producer write + reducer read
+                # of this value both vanish
+                fusion.outs.add(root)
+                fusion.reads[v] = None
+            elif root is not v:
+                # chain collapses onto a materialized root: the fused
+                # kernel reads the root once, not the intermediate
+                fusion.reads[v] = root
+    return fusion
 
 
 class JaxprWalker:
@@ -317,7 +409,9 @@ class JaxprWalker:
         return outs
 
     # ------------------------------------------------------------- costing
-    def _eqn_costs(self, eqn, in_specs, out_specs, mult: float) -> None:
+    def _eqn_costs(self, eqn, in_specs, out_specs, mult: float,
+                   fusion: Optional["_Fusion"] = None,
+                   nbytes=None) -> None:
         name = eqn.primitive.name
         if name == "dot_general":
             (lc, rc), _ = eqn.params["dimension_numbers"]
@@ -364,9 +458,19 @@ class JaxprWalker:
         if name in _MATERIALIZING:
             io = 0.0
             for v, s in zip(eqn.invars, in_specs):
-                if not isinstance(v, Literal):
-                    io += device_bytes(_aval(v).shape, _aval(v).dtype, s)
+                if isinstance(v, Literal):
+                    continue
+                if fusion is not None and v in fusion.reads:
+                    root = fusion.reads[v]
+                    if root is not None:
+                        io += nbytes(root)  # the fused kernel reads the
+                        # chain's root, not the elementwise intermediate
+                    continue  # root fused away with its producer: 0 bytes
+                io += device_bytes(_aval(v).shape, _aval(v).dtype, s)
             for v, s in zip(eqn.outvars, out_specs):
+                if fusion is not None and v in fusion.outs:
+                    continue  # consumed only by a fused reducer: never
+                    # written back to HBM
                 io += device_bytes(_aval(v).shape, _aval(v).dtype, s)
             self.stats.hbm_bytes += mult * io
 
@@ -405,6 +509,8 @@ class JaxprWalker:
                 _aval(v).shape, _aval(v).dtype,
                 specs.get(v, _ones(len(_aval(v).shape))),
             )
+
+        fusion = analyze_fusion(jaxpr)
 
         # ---- liveness: last equation index using each var ----------------
         last_use: Dict[Any, int] = {}
@@ -447,7 +553,8 @@ class JaxprWalker:
                 out_specs = self._out_specs_plain(eqn, e_in_specs)
             for ov, s in zip(eqn.outvars, out_specs):
                 specs[ov] = s
-            self._eqn_costs(eqn, e_in_specs, out_specs, mult)
+            self._eqn_costs(eqn, e_in_specs, out_specs, mult,
+                            fusion=fusion, nbytes=nbytes)
 
             freed = [
                 a for a in {id(a): a for a in eqn.invars

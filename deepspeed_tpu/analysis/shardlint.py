@@ -172,6 +172,36 @@ def trace_train_step(engine):
     return closed, arg_shardings, master_pairs, out_shape, meta
 
 
+def compiled_train_memory_peak(engine):
+    """``(peak_bytes, memory_analysis)`` from XLA's own accounting of
+    the engine's train step (peak = argument + temp + output − alias),
+    via an abstract lower + compile — nothing materializes.
+    ``(None, None)`` when the backend does not report memory analysis.
+    This is the ONE definition of the cross-check anchor the planner's
+    peak band is measured against (tests/test_shardplan.py,
+    tools/autoplan.py --check)."""
+    state = engine.state
+    lowered = engine._jit_train.lower(
+        jax.tree.map(_as_sds, state.params),
+        jax.tree.map(_as_sds, state.opt_state),
+        state.loss_scale,
+        jax.ShapeDtypeStruct((), jnp.int32),
+        _batch_sds(engine),
+        jax.random.PRNGKey(0),
+        None,
+    )
+    ma = lowered.compile().memory_analysis()
+    if not getattr(ma, "temp_size_in_bytes", 0):
+        return None, None
+    peak = (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        + ma.output_size_in_bytes
+        - ma.alias_size_in_bytes
+    )
+    return peak, ma
+
+
 def _engine_level_findings(engine, out_shape) -> List[Finding]:
     """Closure + donation audits at the jit boundary (not jaxpr-visible)."""
     findings: List[Finding] = []

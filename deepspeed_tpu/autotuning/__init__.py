@@ -1,1 +1,8 @@
 from .autotuner import Autotuner, autotune, result_to_config_patch  # noqa: F401
+from .planner_search import (  # noqa: F401
+    Candidate,
+    PlannedCandidate,
+    PlannerSearch,
+    SearchResult,
+    search_config,
+)

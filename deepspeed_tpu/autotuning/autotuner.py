@@ -11,6 +11,21 @@ max_train_micro_batch_size_per_gpu) × remat policies (none is tried first
 at each batch — cheapest when it fits, per the memory/compute tradeoff),
 then a flash-attention tile sweep (block_q × block_k) refines the winner —
 the "tpu_kernels" knob the engine exposes for exactly this loop.
+
+Planner mode (ISSUE 7, default whenever an HBM budget is resolvable —
+``autotuning.hbm_gb``, ``SHARDPLAN_HBM_GB``, or a detected TPU
+generation's capacity; ``autotuning.planner`` forces it either way):
+instead of walking the ladder by compiling, the whole candidate space is
+priced through analysis/cost abstract traces (planner_search.py), rule
+R6 statically prunes what cannot fit, survivors are ranked by roofline
+throughput, and only a top-k (``autotuning.top_k``, default 3) is
+compiled and measured. Each measured survivor banks its
+(predicted, measured) step pair into the drift ledger
+(analysis/cost/drift.py; ``autotuning.drift_ledger`` overrides the
+path) so systematic cost-model drift surfaces as a recalibration
+suggestion instead of silently rotting the ranking. The runtime
+RESOURCE_EXHAUSTED catch in ``_measure`` stays as the backstop for what
+the static estimate misses.
 """
 
 from __future__ import annotations
@@ -73,8 +88,16 @@ class Autotuner:
             at.get("tune_zero_stage",
                    "zero_optimization" not in self.base_config)
         )
+        # planner mode (planner_search.py): None → auto (on when an HBM
+        # budget is resolvable), True/False forces it
+        self.planner: Optional[bool] = at.get("planner")
+        self.top_k = int(at.get("top_k", 3))
+        self.hbm_gb = at.get("hbm_gb")
+        self.drift_ledger_path = at.get("drift_ledger")
         self._zero_patch: Optional[Dict[str, Any]] = None
         self.results: List[Dict[str, Any]] = []
+        self.last_search = None      # SearchResult of the planner phase
+        self.n_compiles = 0          # engines actually built + compiled
 
     def _candidates(self) -> List[Tuple[int, str]]:
         mbs = []
@@ -143,7 +166,8 @@ class Autotuner:
         return cfg
 
     def _measure(self, micro_batch: int, remat: str,
-                 blocks: Tuple[int, int] = (0, 0)) -> Optional[float]:
+                 blocks: Tuple[int, int] = (0, 0),
+                 cfg: Optional[Dict[str, Any]] = None) -> Optional[float]:
         """One candidate: fresh engine → compile+warmup → chained-dispatch
         timing → tokens/sec. This is THE compile+measure loop — the operator
         sweep (tools/sweep_train.py) is a CLI over it, so the two tuners
@@ -155,8 +179,11 @@ class Autotuner:
         median (shared pools are noisy)."""
         import deepspeed_tpu
 
-        cfg = self._candidate_config(micro_batch, remat, blocks)
+        # planner mode passes the candidate's FULL config (extra axes
+        # like tp_overlap differ from what (micro, remat) alone rebuilds)
+        cfg = cfg or self._candidate_config(micro_batch, remat, blocks)
         engine = None
+        self.n_compiles += 1  # the planner-mode contract: ≤ top-k of these
         try:
             engine, *_ = deepspeed_tpu.initialize(
                 model=self.model, config=cfg, topology=self.topology
@@ -267,11 +294,140 @@ class Autotuner:
             "micro_batch=1 with full rematerialisation"
         )
 
+    # ------------------------------------------------------- planner mode
+    def _resolved_budget(self) -> Optional[float]:
+        """The per-device HBM budget planner mode prunes against:
+        explicit ``autotuning.hbm_gb``, then the ``SHARDPLAN_HBM_GB``
+        env, then — only when the chips are real — the detected
+        generation's capacity. On a CPU mesh with nothing armed there is
+        no budget (R6's never-guess-the-machine contract) and the tuner
+        stays on the runtime ladder unless ``planner`` forces it."""
+        import os
+
+        if self.hbm_gb is not None:
+            return float(self.hbm_gb) * float(1 << 30)
+        env = os.environ.get("SHARDPLAN_HBM_GB")
+        if env:
+            return float(env) * float(1 << 30)
+        import jax
+
+        if jax.default_backend() == "tpu":
+            from ..analysis.cost import HardwareModel
+
+            return HardwareModel.detect().hbm_bytes
+        return None
+
+    def _planner_mode(self) -> bool:
+        if self.planner is not None:
+            return bool(self.planner)
+        return self._resolved_budget() is not None
+
+    def _tune_planner(self) -> Dict[str, Any]:
+        """Phase 0+1, planner-driven: enumerate the whole (zero × remat
+        × micro) space through analysis.cost, R6-prune statically, rank
+        by roofline, compile + measure only the top-k. Banks one drift
+        pair per measured survivor."""
+        from ..analysis.cost import drift
+        from ..config import DeepSpeedConfig
+        from .planner_search import PlannerSearch
+
+        if DeepSpeedConfig(dict(self.base_config)).serving.enabled:
+            # the measurement loop below times a TRAIN step; a serving
+            # config's token_budget axis is static-only for now
+            raise NotImplementedError(
+                "planner-mode measurement covers training candidates; "
+                "the serving token_budget search is static-only — rank "
+                "it with tools/autoplan.py and A/B the survivors with "
+                "tools/bench_serve.py"
+            )
+        search = PlannerSearch(
+            self.model, self.base_config, self.topology,
+            top_k=self.top_k, hbm_budget_bytes=self._resolved_budget(),
+            tuner=self,
+        )
+        self.last_search = result = search.search()
+        if not result.survivors:
+            raise RuntimeError(
+                "autotuning: every candidate is statically over the HBM "
+                "budget (planner_search R6) — shard further, offload, or "
+                "raise autotuning.hbm_gb\n" + result.explain()
+            )
+        ledger = drift.DriftLedger(self.drift_ledger_path)
+        best = None
+        for pc in result.top_k:
+            self._zero_patch = pc.cand.zero_dict
+            # the EXACT planned config (incl. axes _candidate_config
+            # alone cannot rebuild, e.g. tp_overlap) is what measures —
+            # the drift pair must compare prediction and wall clock of
+            # the same program
+            cfg = search._candidate_config(pc.cand)
+            tput = self._measure(pc.cand.micro, pc.cand.remat, cfg=cfg)
+            if tput is None:
+                # the static estimate missed: the runtime OOM catch is
+                # still the backstop, the rung just loses its slot
+                log_dist(f"autotune: planner survivor {pc.cand.label()} "
+                         "OOMed at runtime (backstop prune)")
+                continue
+            rec = {
+                "micro_batch": pc.cand.micro,
+                "remat_policy": pc.cand.remat,
+                "throughput": tput,
+                "predicted_step_s": pc.predicted_step_s,
+                "predicted_tokens_per_s": pc.predicted_tput,
+            }
+            if pc.cand.zero_dict is not None:
+                rec["zero_optimization"] = pc.cand.zero_dict
+            if pc.cand.tp_overlap is not None:
+                # carry the full resolved section: result_to_config_patch
+                # replaces sections wholesale, so a bare flag would wipe
+                # tp_size on merge
+                rec["tensor_parallel"] = cfg["tensor_parallel"]
+            self.results.append(rec)
+            log_dist(f"autotune: planner top-k {pc.cand.label()}: "
+                     f"{tput:.0f} tok/s (predicted "
+                     f"{pc.predicted_tput or 0:.0f})")
+            if best is None or tput > best["throughput"]:
+                best = rec
+            try:  # the ledger is evidence, never a point of failure
+                measured_step_s = pc.tokens_per_step / tput
+                ledger.append(drift.make_entry(
+                    pc.plan, measured_step_s,
+                    source=f"autotune:{pc.cand.label()}",
+                    extra={"throughput": round(tput, 1)},
+                ))
+            except Exception as e:  # noqa: BLE001
+                log_dist(f"autotune: drift ledger append failed: {e}")
+        if best is None:
+            raise RuntimeError(
+                "autotuning: all planner-ranked top-k candidates failed "
+                "at runtime; re-run with a lower autotuning.hbm_gb or "
+                "planner=false\n" + result.explain()
+            )
+        # later phases (tile sweep) must measure the winner's sections:
+        # zero via the patch mechanism, tensor_parallel by pinning the
+        # winning section into the base config _candidate_config copies
+        self._zero_patch = best.get("zero_optimization")
+        if "tensor_parallel" in best:
+            self.base_config["tensor_parallel"] = dict(
+                best["tensor_parallel"]
+            )
+        return best
+
     def tune(self) -> Dict[str, Any]:
         """Returns the best config patch: {micro_batch, remat_policy,
         throughput} plus, when the flash tile sweep improved on it,
         tpu_kernels-style {flash_block_q, flash_block_k} keys, and the
-        zero_optimization section phase 0 settled on (when it ran)."""
+        zero_optimization section phase 0 settled on (when it ran).
+        Planner mode (see module docstring) replaces the
+        compile-and-time ladder with a static search + top-k measure."""
+        if self._planner_mode():
+            best = self._tune_planner()
+            return self._sweep_tiles(best)
+        return self._sweep_tiles(self._tune_ladder())
+
+    def _tune_ladder(self) -> Dict[str, Any]:
+        """Phases 0+1, classic: walk the ZeRO ladder and the (micro,
+        remat) grid by compiling, pruning on runtime OOM."""
         best = None
         oom_at = None
         zero = self._pick_zero_stage()
@@ -304,6 +460,19 @@ class Autotuner:
                 best = rec
         if best is None:
             raise RuntimeError("autotuning found no runnable configuration")
+        return best
+
+    def _sweep_tiles(self, best: Dict[str, Any]) -> Dict[str, Any]:
+        """Phases 2+3: the flash tile sweep on the winning (mb, remat).
+        Tile shapes are plan-invariant (the traced program does not
+        change with kernel block sizes), so this stays a measured
+        refinement in planner mode too."""
+        # records carry the winner's zero section so every rec keeps
+        # round-tripping through result_to_config_patch
+        zrec = (
+            {"zero_optimization": best["zero_optimization"]}
+            if "zero_optimization" in best else {}
+        )
         # phase 2: flash tile sweep on the winning (mb, remat)
         if self._flash_tunable():
             for blocks in FLASH_BLOCKS[1:]:
@@ -372,6 +541,11 @@ def result_to_config_patch(rec: Dict[str, Any]) -> Dict[str, Any]:
         )
     if "zero_optimization" in rec:
         patch["zero_optimization"] = dict(rec["zero_optimization"])
+    if "tensor_parallel" in rec:
+        # planner-mode records carry the full section the candidate
+        # measured (tp_size + the decided overlap_comm), so the
+        # wholesale-replace merge semantics stay lossless
+        patch["tensor_parallel"] = dict(rec["tensor_parallel"])
     return patch
 
 

@@ -1,0 +1,432 @@
+"""Planner-driven candidate search: shardplan as the zero-compile cost model.
+
+The phase-0/1 ladder used to discover configurations by compiling and
+timing every candidate, pruning on XLA's RESOURCE_EXHAUSTED. shardplan
+(analysis/cost) already predicts HBM peak, ICI bytes and a roofline step
+time from an ``abstract_init=True`` trace in under a second, so the
+search inverts: enumerate the WHOLE candidate space statically, let rule
+R6 prune everything that cannot fit before anything compiles, rank the
+survivors by predicted throughput, and compile + measure only a top-k
+("Automatic Cross-Replica Sharding of Weight Update", arXiv:2004.13336 —
+derive the placement, don't search it by trial; ZeRO++ arXiv:2306.10209
+prices the ladder's collective traffic analytically the same way).
+
+Candidate axes:
+
+- zero stage × offload (the phase-0 ladder rungs, enriched with the
+  user's non-conflicting zero keys exactly like the runtime ladder);
+- remat policy × micro-batch (powers of two up to the configured max);
+- tp-overlap on/off when the config runs tensor parallelism — the
+  roofline's ``max()`` neutralizes ring bytes that hide under compute,
+  so an overlapped leg never loses rank for declaring its wire traffic
+  while a serial leg's GSPMD collectives stay invisible;
+- serving ``token_budget`` for serving-enabled configs (the slot step
+  is traced through ``lint_serving_config`` instead of a train step);
+- mesh shape (dp×tp factorizations) for capacity dryruns — CLI-only,
+  ``tools/autoplan.py --dryrun-mesh``;
+- flash tiles are enumerable but *plan-invariant* (the traced program
+  does not change with kernel block shapes), so the search carries them
+  only when asked and the measured tile sweep stays the tuner's
+  refinement phase on the winner.
+
+Every pruned rung records WHY it lost (``tools/autoplan.py --explain``),
+and R6 stays the primary pruner only statically: the runtime OOM catch
+in ``Autotuner._measure`` remains the backstop for what the estimate
+misses.
+
+Memoized fast pruning (the ``_is_oom`` hardening): once a (zero, remat)
+group's rung is statically over budget at micro=m, every larger micro in
+the group is derived by scaling the traced plan's batch-linear terms
+(:func:`analysis.cost.scale_plan_micro`) instead of tracing again —
+``n_traced`` counts real traces so tests can hold the line.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import log_dist
+
+_GIB = float(1 << 30)
+
+DEFAULT_TOP_K = 3
+DEFAULT_TOKEN_BUDGETS = (8, 16, 32)
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point of the search space. ``zero`` is the settled
+    zero_optimization section for a ladder rung as canonical JSON (a
+    hashable spelling — sections nest offload dicts; None = the user's
+    own section rides); the optional axes default to "not an axis
+    here"."""
+
+    zero: Optional[str] = None
+    remat: str = "none"
+    micro: int = 1
+    flash_blocks: Tuple[int, ...] = (0, 0)
+    tp_overlap: Optional[bool] = None
+    token_budget: Optional[int] = None
+    mesh: Optional[Tuple[int, int]] = None  # (dp, tp)
+
+    @property
+    def zero_dict(self) -> Optional[Dict[str, Any]]:
+        return json.loads(self.zero) if self.zero is not None else None
+
+    @property
+    def stage(self) -> int:
+        z = self.zero_dict or {}
+        return int(z.get("stage", 0))
+
+    def group_key(self) -> Tuple:
+        """Everything but micro — the memoization group whose plans
+        scale batch-linearly."""
+        return (self.zero, self.remat, self.flash_blocks, self.tp_overlap,
+                self.token_budget, self.mesh)
+
+    def label(self) -> str:
+        z = self.zero_dict
+        if z is None:
+            zs = "zuser"
+        else:
+            zs = f"z{z.get('stage', 0)}"
+            if "offload_optimizer" in z or "offload_param" in z:
+                zs += "off"
+        parts = [zs, self.remat, f"mb{self.micro}"]
+        if self.tp_overlap is not None:
+            parts.append("tpov" if self.tp_overlap else "tpser")
+        if self.token_budget is not None:
+            parts = [f"serve-tb{self.token_budget}"]
+        if self.mesh is not None:
+            parts.append(f"dp{self.mesh[0]}xtp{self.mesh[1]}")
+        if any(self.flash_blocks):
+            parts.append("x".join(str(b) for b in self.flash_blocks))
+        return "/".join(parts)
+
+
+@dataclass
+class PlannedCandidate:
+    """A candidate with its static verdict attached."""
+
+    cand: Candidate
+    plan: Any = None                 # analysis.cost.Plan (None: untraceable)
+    pruned: bool = False
+    reason: str = ""                 # why it lost (R6 message / skip note)
+    traced: bool = False             # False → derived via scale_plan_micro
+    derived_from_micro: Optional[int] = None
+    tokens_per_step: float = 0.0
+
+    @property
+    def predicted_step_s(self) -> Optional[float]:
+        return None if self.plan is None else float(self.plan.est_step_s)
+
+    @property
+    def predicted_tput(self) -> Optional[float]:
+        if self.plan is None or self.plan.est_step_s <= 0:
+            return None
+        return self.tokens_per_step / self.plan.est_step_s
+
+    def row(self) -> Dict[str, Any]:
+        out = {
+            "config": self.cand.label(),
+            "micro_batch": self.cand.micro,
+            "remat_policy": self.cand.remat,
+            "pruned": self.pruned,
+            "traced": self.traced,
+            "reason": self.reason,
+        }
+        z = self.cand.zero_dict
+        if z is not None:
+            out["zero_optimization"] = z
+        if self.plan is not None:
+            out.update(
+                peak_hbm_gib=round(self.plan.peak_hbm_bytes / _GIB, 3),
+                est_step_s=round(self.plan.est_step_s, 6),
+                predicted_tokens_per_s=round(self.predicted_tput or 0.0, 1),
+            )
+        if self.derived_from_micro is not None:
+            out["derived_from_micro"] = self.derived_from_micro
+        return out
+
+
+@dataclass
+class SearchResult:
+    planned: List[PlannedCandidate] = field(default_factory=list)
+    survivors: List[PlannedCandidate] = field(default_factory=list)
+    top_k: List[PlannedCandidate] = field(default_factory=list)
+    n_traced: int = 0
+    budget_bytes: Optional[float] = None
+
+    @property
+    def pruned(self) -> List[PlannedCandidate]:
+        return [p for p in self.planned if p.pruned]
+
+    def explain(self) -> str:
+        """The --explain table: every candidate, ranked survivors first,
+        each pruned rung naming why it lost."""
+        lines = []
+        budget = (f"{self.budget_bytes / _GIB:.2f}G"
+                  if self.budget_bytes else "-")
+        head = (f"{'rank':<5}{'config':<30}{'peak':>9}{'budget':>9}"
+                f"{'est step':>12}{'pred tok/s':>12}  verdict")
+        lines.append(head)
+        lines.append("-" * len(head))
+
+        def fmt(pc: PlannedCandidate, rank: str, verdict: str) -> str:
+            peak = (f"{pc.plan.peak_hbm_bytes / _GIB:.2f}G"
+                    if pc.plan is not None else "-")
+            step = (f"{pc.plan.est_step_s:.4g}s"
+                    if pc.plan is not None else "-")
+            tput = (f"{pc.predicted_tput:,.0f}"
+                    if pc.predicted_tput else "-")
+            return (f"{rank:<5}{pc.cand.label()[:29]:<30}{peak:>9}"
+                    f"{budget:>9}{step:>12}{tput:>12}  {verdict}")
+
+        best = self.survivors[0].predicted_tput if self.survivors else None
+        for i, pc in enumerate(self.survivors):
+            verdict = "compile+measure" if pc in self.top_k else (
+                "ranked out"
+                + (f": {100 * (1 - (pc.predicted_tput or 0) / best):.0f}% "
+                   f"behind the predicted winner" if best else "")
+            )
+            lines.append(fmt(pc, str(i + 1), verdict))
+        for pc in self.pruned:
+            why = pc.reason
+            if not pc.traced and pc.derived_from_micro is not None:
+                why += (f" [derived from mb={pc.derived_from_micro} "
+                        "without re-tracing]")
+            lines.append(fmt(pc, "-", f"pruned: {why}"))
+        lines.append(
+            f"{len(self.survivors)} survivors / {len(self.planned)} "
+            f"candidates, {self.n_traced} traced, top-{len(self.top_k)} "
+            "compiled"
+        )
+        return "\n".join(lines)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "n_candidates": len(self.planned),
+            "n_traced": self.n_traced,
+            "survivors": [p.row() for p in self.survivors],
+            "pruned": [p.row() for p in self.pruned],
+            "top_k": [p.row() for p in self.top_k],
+        }
+
+
+class PlannerSearch:
+    """Enumerate → plan (abstract trace, memoized) → R6-prune → rank.
+
+    Shares the Autotuner's config builder so a planned candidate and a
+    measured candidate are byte-identical ds_configs — the search cannot
+    drift from what the probes actually run."""
+
+    def __init__(self, model, base_config: Dict[str, Any], topology=None,
+                 *, top_k: int = DEFAULT_TOP_K,
+                 hbm_budget_bytes: Optional[float] = None,
+                 hardware=None,
+                 mesh_shapes: Optional[Sequence[Tuple[int, int]]] = None,
+                 token_budgets: Sequence[int] = DEFAULT_TOKEN_BUDGETS,
+                 include_tiles: bool = False,
+                 tuner=None):
+        from .autotuner import Autotuner
+
+        self.model = model
+        self.base_config = dict(base_config)
+        self.topology = topology
+        self.top_k = int(top_k)
+        self.hardware = hardware
+        self.mesh_shapes = list(mesh_shapes or [])
+        self.token_budgets = tuple(token_budgets)
+        self.include_tiles = include_tiles
+        self.tuner = tuner or Autotuner(
+            model, base_config, topology=topology, sample_batch_fn=None
+        )
+        if hbm_budget_bytes is None:
+            at = dict(self.base_config.get("autotuning") or {})
+            if at.get("hbm_gb") is not None:
+                hbm_budget_bytes = float(at["hbm_gb"]) * _GIB
+        self.budget_bytes = hbm_budget_bytes
+        self.n_traced = 0
+
+    # ------------------------------------------------------------ enumerate
+    def _zero_axis(self) -> List[Optional[str]]:
+        from .autotuner import ZERO_LADDER
+
+        if not self.tuner.tune_zero:
+            return [None]
+        pipe = dict(self.base_config.get("pipeline") or {})
+        ladder = ZERO_LADDER
+        if int(pipe.get("stages", 1)) > 1:
+            ladder = tuple(z for z in ladder if z["stage"] <= 1)
+        return [
+            json.dumps(self.tuner._settled_zero(z), sort_keys=True)
+            for z in ladder
+        ]
+
+    def candidates(self) -> List[Candidate]:
+        from ..config import DeepSpeedConfig
+        from .autotuner import FLASH_BLOCKS, REMAT_POLICIES
+
+        ds = DeepSpeedConfig(dict(self.base_config))
+        if getattr(ds.serving, "enabled", False):
+            return [Candidate(token_budget=tb) for tb in self.token_budgets]
+        mbs = []
+        m = 1
+        while m <= self.tuner.max_micro:
+            mbs.append(m)
+            m *= 2
+        tp = max(int(ds.tensor_parallel.tp_size), 1)
+        overlap_axis: List[Optional[bool]] = (
+            [False, True] if tp > 1 else [None]
+        )
+        tiles = FLASH_BLOCKS if self.include_tiles else ((0, 0),)
+        meshes: List[Optional[Tuple[int, int]]] = (
+            list(self.mesh_shapes) if self.mesh_shapes else [None]
+        )
+        out = []
+        for mesh in meshes:
+            for zero in self._zero_axis():
+                for pol in REMAT_POLICIES:
+                    for mb in mbs:
+                        for ov in overlap_axis:
+                            for blocks in tiles:
+                                out.append(Candidate(
+                                    zero=zero, remat=pol, micro=mb,
+                                    flash_blocks=tuple(blocks),
+                                    tp_overlap=ov, mesh=mesh,
+                                ))
+        return out
+
+    # ----------------------------------------------------------------- plan
+    def _candidate_config(self, cand: Candidate) -> Dict[str, Any]:
+        prev = self.tuner._zero_patch
+        try:
+            self.tuner._zero_patch = cand.zero_dict
+            cfg = self.tuner._candidate_config(
+                cand.micro, cand.remat, cand.flash_blocks
+            )
+        finally:
+            self.tuner._zero_patch = prev
+        if cand.tp_overlap is not None:
+            tp = dict(cfg.get("tensor_parallel") or {})
+            oc = dict(tp.get("overlap_comm") or {})
+            oc["enabled"] = bool(cand.tp_overlap)
+            tp["overlap_comm"] = oc
+            cfg["tensor_parallel"] = tp
+        if cand.token_budget is not None:
+            sv = dict(cfg.get("serving") or {})
+            sv["token_budget"] = int(cand.token_budget)
+            cfg["serving"] = sv
+        return cfg
+
+    def _topology_for(self, cand: Candidate):
+        if cand.mesh is None:
+            return self.topology
+        from ..comm.topology import MeshTopology, ParallelDims
+
+        dp, tp = cand.mesh
+        return MeshTopology(dims=ParallelDims(dp=dp, tp=tp))
+
+    def _tokens_per_step(self, cand: Candidate, cfg: Dict[str, Any]) -> float:
+        if cand.token_budget is not None:
+            return float(cand.token_budget)
+        S = getattr(getattr(self.model, "config", None), "max_seq_len", 1)
+        B = cfg.get("train_batch_size") or cand.micro
+        return float(B) * float(S)
+
+    def _plan_one(self, cand: Candidate) -> PlannedCandidate:
+        import deepspeed_tpu.comm as comm
+        from ..analysis import lint_config
+
+        cfg = self._candidate_config(cand)
+        pc = PlannedCandidate(cand=cand)
+        try:
+            if self.topology is None or cand.mesh is not None:
+                comm.destroy_process_group()
+            report = lint_config(
+                cfg, model=self.model, topology=self._topology_for(cand),
+                only=["R6"], hbm_budget_bytes=self.budget_bytes,
+                collect_plan=True, source=cand.label(),
+                hardware=self.hardware,
+            )
+        except NotImplementedError as e:
+            pc.pruned = True
+            pc.reason = f"untraceable on this jax: {str(e).splitlines()[0][:120]}"
+            return pc
+        except Exception as e:  # noqa: BLE001 — an unbuildable candidate
+            # (config validation, batch triangle) loses with its reason
+            # instead of killing the search
+            pc.pruned = True
+            pc.reason = (str(e).splitlines() or [repr(e)])[0][:160]
+            return pc
+        self.n_traced += 1
+        pc.traced = True
+        pc.plan = report.plans[0] if report.plans else None
+        pc.tokens_per_step = self._tokens_per_step(cand, cfg)
+        r6 = [f for f in report.findings if f.rule == "R6"]
+        if r6:
+            pc.pruned = True
+            pc.reason = r6[0].message.split(" — ")[0]
+        return pc
+
+    def _derive_scaled(self, cand: Candidate,
+                       prior: PlannedCandidate) -> PlannedCandidate:
+        from ..analysis.cost import scale_plan_micro
+
+        f = cand.micro / prior.cand.micro
+        plan = scale_plan_micro(prior.plan, f, source=cand.label())
+        pc = PlannedCandidate(
+            cand=cand, plan=plan, pruned=True, traced=False,
+            derived_from_micro=prior.cand.micro,
+            tokens_per_step=prior.tokens_per_step * f,
+        )
+        pc.reason = (
+            f"estimated peak HBM {plan.peak_hbm_bytes / _GIB:.2f} GiB "
+            f"exceeds the {self.budget_bytes / _GIB:.2f} GiB budget"
+        )
+        return pc
+
+    # --------------------------------------------------------------- search
+    def search(self) -> SearchResult:
+        result = SearchResult(budget_bytes=self.budget_bytes)
+        memo: Dict[Tuple, PlannedCandidate] = {}  # group → last pruned trace
+        for cand in sorted(self.candidates(),
+                           key=lambda c: (c.group_key(), c.micro)):
+            prior = memo.get(cand.group_key())
+            if (prior is not None and prior.pruned and prior.plan is not None
+                    and cand.micro > prior.cand.micro):
+                # the smaller micro already failed R6 statically; a larger
+                # one only grows the batch-linear terms — skip the trace
+                result.planned.append(self._derive_scaled(cand, prior))
+                continue
+            pc = self._plan_one(cand)
+            result.planned.append(pc)
+            if pc.pruned and pc.traced and pc.plan is not None:
+                memo[cand.group_key()] = pc
+        result.n_traced = self.n_traced
+        survivors = [p for p in result.planned if not p.pruned]
+        # roofline throughput is micro-invariant (tokens and seconds both
+        # scale), so ties break toward the lower stage (less collective
+        # traffic — the ladder's own preference) and the LARGER micro
+        # (fewer dispatches per token, the direction every measured sweep
+        # has confirmed)
+        survivors.sort(key=lambda p: (
+            -(p.predicted_tput or 0.0), p.cand.stage, -p.cand.micro
+        ))
+        result.survivors = survivors
+        result.top_k = survivors[:max(self.top_k, 1)]
+        log_dist(
+            f"planner_search: {len(result.planned)} candidates, "
+            f"{len(result.pruned)} statically pruned, {self.n_traced} "
+            f"traced, top-{len(result.top_k)} to compile"
+        )
+        return result
+
+
+def search_config(model, base_config, topology=None, **kw) -> SearchResult:
+    """One-call spelling (tools/autoplan.py): enumerate + plan + rank a
+    config's candidate space without compiling anything."""
+    return PlannerSearch(model, base_config, topology, **kw).search()

@@ -14,6 +14,9 @@ trace-ready evidence of one statically-visible bug class:
 - ``truncated_master``      R5: f32 master rebuilt through bf16
 - ``pinned_host_compute``   R5: host-resident bytes fed to compute
 - ``hbm_over_budget``       R6: estimated peak exceeds the HBM budget
+- ``autotuner_rung_oom``    R6: a fat-micro autotuner rung statically
+  over the shared budget (the planner-search prune; the clean twin is
+  the thin-micro rung under the SAME budget)
 - ``reshard_transpose_pair`` R7: transpose∘reshard∘transpose identity
 - ``unhideable_offload_stream`` R8: declared-overlapped stream bigger
   than the compute window
@@ -388,6 +391,42 @@ def hbm_over_budget_clean():
     return closed, {"mesh": mesh, "hbm_budget_bytes": 1 << 30}, "R6"
 
 
+# ------------------------------------------------------------------ R6 bis
+def _autotune_rung(micro: int):
+    """An autotuner rung's shape: a per-device [micro, S, H] activation
+    batch through a two-matmul block to a loss. The planner-driven
+    search prices exactly this kind of program per (stage, remat, micro)
+    rung; the hazard is the fat-micro rung whose activation live set
+    statically exceeds the budget BOTH twins share — R6 prunes it before
+    any compile, the thin rung passes (the prune-before-compile
+    contract, docs/memory_planner.md)."""
+    mesh = corpus_mesh()
+
+    def prog(x, w1, w2):
+        h = jnp.tanh(jnp.einsum("bsh,hk->bsk", x, w1))
+        y = jnp.einsum("bsk,kh->bsh", h, w2)
+        return ((y - x) ** 2).sum()
+
+    x = jax.ShapeDtypeStruct((micro, 128, 256), jnp.float32)
+    w1 = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    w2 = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    closed = jax.make_jaxpr(prog)(x, w1, w2)
+    # 3 MiB/device: holds weights + the mb=1 rung's live set (~0.9 MiB)
+    # with room, and is crossed by mb=16 (x alone is 2 MiB, h/y double it)
+    kw = {"mesh": mesh, "hbm_budget_bytes": 3 * (1 << 20)}
+    return closed, kw
+
+
+def autotuner_rung_oom():
+    closed, kw = _autotune_rung(16)
+    return closed, kw, "R6"
+
+
+def autotuner_rung_oom_clean():
+    closed, kw = _autotune_rung(1)
+    return closed, kw, "R6"
+
+
 # --------------------------------------------------------------------- R7
 def _reshard_pair(mesh, roundtrip: bool):
     # the hazard: transpose → reshard → transpose⁻¹, all single-use —
@@ -469,6 +508,7 @@ HAZARDS = [
     pinned_host_compute,
     tp_overlap_malformed_ring,
     hbm_over_budget,
+    autotuner_rung_oom,
     reshard_transpose_pair,
     unhideable_offload_stream,
 ]
@@ -484,6 +524,7 @@ CLEAN_TWINS = [
     pinned_host_compute_clean,
     tp_overlap_ring_clean,
     hbm_over_budget_clean,
+    autotuner_rung_oom_clean,
     reshard_transpose_pair_clean,
     unhideable_offload_stream_clean,
 ]

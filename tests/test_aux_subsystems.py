@@ -371,7 +371,11 @@ def test_checkpointing_user_api():
         np.testing.assert_allclose(float(val), float(f(w, x)), rtol=1e-6)
         g1 = jax.grad(lambda w: checkpointing.checkpoint(f, w, x))(w)
         g2 = jax.grad(lambda w: f(w, x))(w)
-        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
+        # remat replays the saved-dots policy in the backward, so the grad
+        # is FP-reassociated vs the plain path — atol floors the near-zero
+        # elements whose relative error is meaningless
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5, atol=1e-6)
 
         # ds_config + checkpoint_in_cpu routing
         checkpointing.configure(

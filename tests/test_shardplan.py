@@ -32,7 +32,7 @@ from deepspeed_tpu.analysis.cost import (
     pipeline_temp_bytes,
     stash_boundaries,
 )
-from deepspeed_tpu.analysis.shardlint import _as_sds, _batch_sds
+from deepspeed_tpu.analysis.shardlint import compiled_train_memory_peak
 from deepspeed_tpu.models import gpt2
 
 pytestmark = pytest.mark.shardlint
@@ -92,10 +92,12 @@ def test_planner_abstract_equals_concrete_state_bytes(devices8):
     assert abstract.opt_bytes == concrete.opt_bytes
 
 
-def test_planner_peak_within_15pct_of_xla_410m(devices8):
-    """ISSUE 4 acceptance: peak-HBM estimate within ±15% of
-    ``compiled.memory_analysis()`` on the CPU-mesh 410M bench leg (the
-    exact program the lint traces — XLA CPU compiles it in seconds)."""
+def test_planner_peak_within_10pct_of_xla_410m(devices8):
+    """ISSUE 4 acceptance, re-tightened by ISSUE 7: peak-HBM estimate
+    within ±10% of ``compiled.memory_analysis()`` on the CPU-mesh 410M
+    bench leg (the exact program the lint traces — XLA CPU compiles it
+    in seconds). Measured 1.04 with the fused-elementwise coalescing
+    landed; the band leaves room for jax version drift only."""
     import bench
 
     name, model, cfg = bench.lint_targets(len(jax.devices()))[0]
@@ -103,27 +105,11 @@ def test_planner_peak_within_15pct_of_xla_410m(devices8):
     engine = _engine(cfg, model=model)
     plan = plan_engine(engine, source=name)
 
-    state = engine.state
-    lowered = engine._jit_train.lower(
-        jax.tree.map(_as_sds, state.params),
-        jax.tree.map(_as_sds, state.opt_state),
-        state.loss_scale,
-        jax.ShapeDtypeStruct((), jnp.int32),
-        _batch_sds(engine),
-        jax.random.PRNGKey(0),
-        None,
-    )
-    ma = lowered.compile().memory_analysis()
-    if not getattr(ma, "temp_size_in_bytes", 0):
+    xla_peak, ma = compiled_train_memory_peak(engine)
+    if xla_peak is None:
         pytest.skip("XLA does not report memory analysis on this backend")
-    xla_peak = (
-        ma.argument_size_in_bytes
-        + ma.temp_size_in_bytes
-        + ma.output_size_in_bytes
-        - ma.alias_size_in_bytes
-    )
     ratio = plan.peak_hbm_bytes / xla_peak
-    assert 0.85 <= ratio <= 1.15, (
+    assert 0.90 <= ratio <= 1.10, (
         f"plan {plan.peak_hbm_bytes / 2**30:.2f} GiB vs XLA "
         f"{xla_peak / 2**30:.2f} GiB (ratio {ratio:.3f})"
     )
@@ -278,6 +264,39 @@ def test_shardplan_cli_budget_exit_codes(devices8, tmp_path):
     assert over.returncode == 1, over.stdout + over.stderr
     assert "R6" in over.stdout
     assert time.time() - t0 < 120.0  # two cold CLI runs stay snappy
+
+
+def test_walk_coalesces_fused_elementwise_chains(devices8):
+    """ISSUE 7 satellite: a materializing producer whose single-use
+    output feeds a reduction (through a single-use elementwise chain)
+    fuses in XLA — the intermediate never moves through HBM, so the walk
+    must not charge the producer's write AND the reducer's read."""
+    from deepspeed_tpu.analysis.cost.walk import JaxprWalker
+
+    def fused(x, w):
+        h = jnp.einsum("bk,kn->bn", x, w)
+        return (h * 2.0).sum()
+
+    def materialized(x, w):
+        h = jnp.einsum("bk,kn->bn", x, w)
+        # h is multi-use: it really materializes, both charges stand
+        return (h * 2.0).sum() + h[0, 0]
+
+    x = jax.ShapeDtypeStruct((256, 512), jnp.float32)
+    w = jax.ShapeDtypeStruct((512, 512), jnp.float32)
+
+    def traffic(fn):
+        closed = jax.make_jaxpr(fn)(x, w)
+        walker = JaxprWalker({})
+        walker.walk(closed.jaxpr, [(1, 1), (1, 1)])
+        return walker.stats.hbm_bytes
+
+    h_bytes = 256 * 512 * 4
+    io_fused = traffic(fused)
+    # fused triple: reads of x and w plus the scalar out — h uncharged
+    assert io_fused == x.size * 4 + w.size * 4 + 4, io_fused
+    # the multi-use twin keeps the write+read of h (plus the slice path)
+    assert traffic(materialized) >= io_fused + 2 * h_bytes
 
 
 def test_pipeline_estimator_laws():

@@ -80,6 +80,12 @@ def iter_targets(args):
 
         for name, model, cfg in bench.lint_targets(len(jax.devices())):
             yield name, model, cfg
+        # the autotuner's ladder rungs are configs too (ISSUE 7): the
+        # planner-driven search only measures rungs that lint clean
+        for name, model, cfg in bench.autotune_rung_targets(
+            len(jax.devices())
+        ):
+            yield name, model, cfg
 
 
 def run_lint(args, collect_plan=False):
