@@ -418,6 +418,38 @@ def plan_summary(engine, name: str, measured_step_s=None,
         return None
 
 
+def trace_phase_table(engine, data, tag: str):
+    """steptrace phase breakdown for the bench leg (ISSUE 8 satellite):
+    runs AFTER the timed measurement — the span fences (block_until_ready
+    at span close) would otherwise serialize async dispatch and perturb
+    the banked number — traces two steps, exports the Chrome trace next
+    to the drift ledger (perf/trace_<tag>.json) and prints the per-phase
+    table beside the plan table. Best-effort: a bench number must never
+    die on its accounting line. Returns the export path or None."""
+    try:
+        tr = engine.enable_tracing()
+        for _ in range(2):
+            engine.train_batch(batch=data)
+        os.makedirs(os.path.join(REPO_DIR, "perf"), exist_ok=True)
+        path = engine.trace_export(
+            os.path.join(REPO_DIR, "perf", f"trace_{tag}.json")
+        )
+        print(tr.phase_table(prefix="train/"), file=sys.stderr)
+        print(f"bench: steptrace trace -> {path} "
+              f"(tools/trace_report.py)", file=sys.stderr)
+        phases = {
+            name: round(tr.mean_dur(name), 4)
+            for name in sorted({s["name"] for s in tr.spans})
+            if name.startswith("train/")
+        }
+        return {"trace": path, "phase_mean_s": phases}
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: steptrace phase table skipped: "
+              f"{(str(e).splitlines() or [repr(e)])[0][:160]}",
+              file=sys.stderr)
+        return None
+
+
 def load_sweep_seed(dp: int, B: int):
     """The committed sweep winner (SWEEP_BEST.json, written by
     tools/sweep_train.py) becomes the ladder's first rung — on the 16GB
@@ -580,6 +612,9 @@ def main():
     # dispatch-dominated, its ratio would only pollute the evidence.
     plan = plan_summary(engine, f"bench-{model_tag()}", measured_step_s=dt,
                         bank_drift=not smoke)
+    # phase breakdown rides along with the plan table (traced steps run
+    # after the timed window, so the fences cannot touch the record)
+    steptrace_col = trace_phase_table(engine, data, model_tag())
     if offload is not None and os.environ.get("BENCH_OFFLOAD_AB") and big:
         # A/B the double-buffer knob in the same window: rebuild the
         # engine (the 1.5B state doesn't fit twice) with the knob flipped
@@ -664,6 +699,10 @@ def main():
         result["offload"] = offload
     if plan is not None:
         result["plan"] = plan
+    if steptrace_col is not None:
+        # the BENCH record's phase-breakdown column (ISSUE 8): per-phase
+        # mean seconds from the traced post-measurement steps
+        result["steptrace"] = steptrace_col
     if not smoke:
         note = bank_record(cls, result)
         if note:

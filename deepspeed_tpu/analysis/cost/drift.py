@@ -110,11 +110,24 @@ class DriftLedger:
         self.path = path or default_ledger_path()
 
     def append(self, entry: Dict[str, Any]) -> None:
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(self.path, "a") as f:
-            f.write(json.dumps(entry) + "\n")
+        """Best-effort append: an unwritable ledger (read-only CI
+        checkout, a path component that's a file, missing permissions)
+        logs ONE warning and drops the entry — the ledger is evidence,
+        and evidence-keeping must never crash a bench or tuner run."""
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError as e:
+            from ...utils.logging import logger
+
+            logger.warning(
+                f"drift ledger unwritable ({self.path}): {e} — entry "
+                "dropped, run continues (set SHARDPLAN_DRIFT_LEDGER to "
+                "a writable path to keep banking pairs)"
+            )
 
     def load(self, gen: Optional[str] = None,
              source: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -131,7 +144,9 @@ class DriftLedger:
                         rows.append(json.loads(line))
                     except ValueError:
                         continue
-        except FileNotFoundError:
+        except OSError:
+            # missing file, unreadable path, path component that's a
+            # file — no evidence is just an empty ledger, never a crash
             return []
         if gen is not None:
             rows = [r for r in rows if r.get("gen") == gen]

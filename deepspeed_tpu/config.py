@@ -322,6 +322,30 @@ class ServingConfig:
 
 
 @dataclass
+class SteptraceConfig:
+    """"steptrace" section — structured span tracing + the process-global
+    metrics registry (profiling/steptrace.py, docs/observability.md).
+    Host-side only: spans bracket dispatches and fence with
+    ``jax.block_until_ready`` at close; nothing is traced inside jitted
+    programs. MUST be zero-overhead when disabled — engines keep
+    ``tracer = None`` and allocate no spans."""
+
+    enabled: bool = False
+    max_spans: int = 100_000   # registry bound (spans / async events /
+                               # metric samples each); beyond it entries
+                               # are counted in ``dropped``, not stored
+    export_path: Optional[str] = None  # default target of
+                               # ``engine.trace_export()`` (Chrome
+                               # trace-event JSON)
+
+    def validate(self) -> None:
+        if int(self.max_spans) < 1:
+            raise DeepSpeedConfigError(
+                f"steptrace.max_spans must be >= 1, got {self.max_spans}"
+            )
+
+
+@dataclass
 class FlopsProfilerConfig:
     enabled: bool = False
     profile_step: int = 1
@@ -591,6 +615,7 @@ class DeepSpeedConfig:
             SparseAttentionConfig, d.get("sparse_attention")
         )
         self.checkpoint = _parse_dc(CheckpointConfig, d.get("checkpoint"))
+        self.steptrace = _parse_dc(SteptraceConfig, d.get("steptrace"))
         self.flops_profiler = _parse_dc(FlopsProfilerConfig, d.get("flops_profiler"))
         self.comms_logger = _parse_dc(CommsLoggerConfig, d.get("comms_logger"))
         self.monitor = MonitorConfig(
@@ -702,6 +727,7 @@ class DeepSpeedConfig:
             )
         self.sparse_attention.validate()
         self.checkpoint.validate()
+        self.steptrace.validate()
         if self.sparse_attention.mode not in ("none", "dense") and (
             self.sequence_parallel.sp_size > 1
         ):

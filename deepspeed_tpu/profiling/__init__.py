@@ -1,2 +1,4 @@
 from .comm_logger import CommsLogger  # noqa: F401
 from .flops_profiler import FlopsProfiler  # noqa: F401
+from .steptrace import (MetricsRegistry, ServeTracer,  # noqa: F401
+                        get_registry)

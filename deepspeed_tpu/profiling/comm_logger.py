@@ -41,11 +41,19 @@ def get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
 
 
 class CommsLogger:
-    """Records per-op counts/bytes; prints a summary table on demand."""
+    """Records per-op counts/bytes; prints a summary table on demand.
 
-    def __init__(self, config=None):
+    With a steptrace ``registry`` attached (profiling/steptrace.py),
+    every analytic-stream record (``record_streams`` / ``record_ring``
+    / ``record_offload`` / ``record_kv``) also emits a ``comm/*``
+    registry sample, so a traced run sees the hidden-stream accounting
+    on the same timeline as its spans. ``registry=None`` (default) is
+    the zero-overhead path."""
+
+    def __init__(self, config=None, registry=None):
         self.verbose = bool(getattr(config, "verbose", False))
         self.prof_all = bool(getattr(config, "prof_all", True))
+        self.registry = registry
         self.prof_ops: List[str] = list(getattr(config, "prof_ops", []) or [])
         self.counts: Dict[str, int] = defaultdict(int)
         self.bytes: Dict[str, int] = defaultdict(int)
@@ -107,6 +115,11 @@ class CommsLogger:
         self.offload_bytes_out += nbytes_out * steps
         self.offload_slots = max(self.offload_slots, slots)
         self.offload_slot_bytes = max(self.offload_slot_bytes, slot_bytes)
+        if self.registry is not None:
+            self.registry.sample(
+                "comm/offload_bytes_per_step", nbytes_in + nbytes_out,
+                step=self.offload_steps,
+            )
 
     @property
     def offload_bytes_in_flight(self) -> int:
@@ -120,6 +133,9 @@ class CommsLogger:
         of one optimizer step (forward + transposed backward hops)."""
         self.ring_steps += steps
         self.ring_bytes += nbytes_per_step * steps
+        if self.registry is not None:
+            self.registry.sample("comm/ring_bytes_per_step", nbytes_per_step,
+                                 step=self.ring_steps)
 
     # -------------------------------------------------- serving KV stats
     def record_kv(self, nbytes_per_step: int, steps: int = 1) -> None:
@@ -128,6 +144,9 @@ class CommsLogger:
         per step; serving/engine.serving_kv_stream)."""
         self.kv_steps += steps
         self.kv_bytes += nbytes_per_step * steps
+        if self.registry is not None:
+            self.registry.sample("comm/kv_bytes_per_step", nbytes_per_step,
+                                 step=self.kv_steps)
 
     def kv_summary(self, duration_s: Optional[float] = None) -> str:
         """One line of serving KV-arena accounting (empty when idle)."""
@@ -278,3 +297,35 @@ class CommsLogger:
 
     def log_summary(self, axis_sizes: Optional[Dict[str, int]] = None) -> None:
         log_dist("comms summary (trace-time ops)\n" + self.summary(axis_sizes))
+
+    def write_to(self, monitor, step: int) -> None:
+        """Feed the monitor backends through the steptrace registry's
+        single ``write_events`` bridge (one coherent ``comm/*``
+        namespace next to ``train/*``/``serve/*``/``plan/*``)."""
+        from .steptrace import write_events
+
+        events = [
+            (f"comm/{op}_bytes", float(b), step)
+            for op, b in sorted(self.bytes.items())
+        ]
+        # _avg tags: these are running means over the whole window — the
+        # per-step instantaneous samples live under the un-suffixed tags
+        # (record_offload/record_ring/record_kv registry emitters); one
+        # tag must never carry both semantics
+        if self.offload_steps:
+            events.append((
+                "comm/offload_bytes_per_step_avg",
+                float(self.offload_bytes_in + self.offload_bytes_out)
+                / self.offload_steps, step,
+            ))
+        if self.ring_steps:
+            events.append((
+                "comm/ring_bytes_per_step_avg",
+                float(self.ring_bytes) / self.ring_steps, step,
+            ))
+        if self.kv_steps:
+            events.append((
+                "comm/kv_bytes_per_step_avg",
+                float(self.kv_bytes) / self.kv_steps, step,
+            ))
+        write_events(monitor, events)

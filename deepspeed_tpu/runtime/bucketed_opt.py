@@ -62,6 +62,18 @@ class BucketedOptimizer:
         rest = {k: v for k, v in tree.items() if k != self.key}
         return rest, tree[self.key]
 
+    def stream_annotation(self) -> Dict[str, Any]:
+        """steptrace args for the engine's ``plan/offload`` span: the
+        offload-DMA phase structure (rotating-slot depth, prefetch
+        on/off) that the scan hides inside one jitted program — the
+        host-side trace can't bracket per-layer DMAs, so the span
+        carries the declared shape instead (docs/observability.md)."""
+        return {
+            "offload_double_buffer": bool(self.double_buffer),
+            "rotating_slots": 2 if self.double_buffer else 1,
+            "stacked_key": self.key,
+        }
+
     def init(self, params):
         rest, layers = self.split(params)
         return {

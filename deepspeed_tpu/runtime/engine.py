@@ -247,10 +247,23 @@ class TpuEngine:
 
             self.monitor = MonitorMaster(config.monitor)
         self.comm_logger = None
+        # steptrace (config-gated; docs/observability.md). None is the
+        # zero-overhead path: every instrumentation site guards on it,
+        # so no span ever allocates. Abstract (lint) shells never trace.
+        self.tracer = None
+        self._steptrace_export_path = None
+        if config.steptrace.enabled and not self.abstract:
+            from ..profiling import steptrace as _steptrace
+
+            self.tracer = _steptrace.configure(
+                max_spans=config.steptrace.max_spans
+            )
+            self._steptrace_export_path = config.steptrace.export_path
         if config.comms_logger.enabled:
             from ..profiling.comm_logger import CommsLogger
 
-            self.comm_logger = CommsLogger(config.comms_logger)
+            self.comm_logger = CommsLogger(config.comms_logger,
+                                           registry=self.tracer)
 
         self.fp16_enabled = config.fp16.enabled
         self.compute_dtype = config.compute_dtype
@@ -1452,9 +1465,17 @@ class TpuEngine:
                 for k, v in batch.items()
             }
         breakdown = self.config.wall_clock_breakdown
+        tr = self.tracer
+        step_sp = (
+            tr.begin("train/step", "train", {"step": self.global_steps + 1})
+            if tr else None
+        )
         if breakdown:
             self.timers("batch_prep").start()
+        prep_sp = tr.begin("train/batch_prep", "train") if tr else None
         prepared = self._prepare_batch(batch)
+        if prep_sp is not None:
+            prep_sp.end()
         if breakdown:
             self.timers("batch_prep").stop()
         ltd_keep = None
@@ -1472,19 +1493,44 @@ class TpuEngine:
         with use_topology(self.topology):
             if self._nvme_swapper is not None:
                 # dispatch grads async, then overlap the NVMe swap-in with
-                # the device's fwd+bwd time; the update program follows
+                # the device's fwd+bwd time; the update program follows.
+                # Span discipline: the fwd_bwd dispatch span does NOT
+                # fence (a fence here would serialize the swap-in against
+                # the device work — the very overlap being traced); the
+                # train/device span at the bottom owns the blocking wait.
+                sp = tr.begin("train/fwd_bwd_dispatch", "train") if tr \
+                    else None
                 grads, loss, mmetrics = self._jit_grads(
                     self.state.params, self.state.loss_scale, self.state.step,
                     prepared, self.next_rng(), ltd_keep,
                 )
+                if sp is not None:
+                    sp.end()
+                    sp = tr.begin("train/offload_swap_in", "train")
                 self._swap_in_opt()
+                if sp is not None:
+                    sp.end()
+                    sp = tr.begin("train/optimizer_dispatch", "train")
                 p, o, s, st, metrics = self._jit_update(
                     *self.state.astuple(), grads, loss, mmetrics
                 )
+                if sp is not None:
+                    sp.end()
             else:
+                sp = tr.begin("train/dispatch", "train") if tr else None
                 p, o, s, st, metrics = self._jit_train(
                     *self.state.astuple(), prepared, self.next_rng(), ltd_keep
                 )
+                if sp is not None:
+                    sp.end()
+        if tr is not None:
+            # fence at close: the async-dispatched fwd/bwd/optimizer work
+            # is charged to this span (utils/timer.py block_on
+            # discipline). This runs BEFORE the state assignment below —
+            # replacing the old (donated) state while the step is still
+            # in flight blocks inside the assignment, which would
+            # silently attribute the whole device time to host work.
+            tr.begin("train/device", "train").end(fence=metrics["loss"])
         self.state = TrainState(p, o, s, st)
         if breakdown:
             # dispatch returns immediately; a second timer blocks on the
@@ -1495,7 +1541,10 @@ class TpuEngine:
             if (self.global_steps + 1) % self.config.steps_per_print == 0:
                 self.timers.log(["batch_prep", "step_dispatch", "step_device"])
         if self._nvme_swapper is not None:
+            sp = tr.begin("train/offload_swap_out", "train") if tr else None
             self._swap_out_opt(blocking=False)  # writes overlap next step
+            if sp is not None:
+                sp.end()
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         self._record_offload_stream(batch=prepared)
@@ -1517,6 +1566,8 @@ class TpuEngine:
             see_memory_usage(f"step {self.global_steps}")
         self._emit_step_log(metrics, self.global_steps)
         self.tput.stop()
+        if step_sp is not None:
+            step_sp.end()
         return metrics["loss"]
 
     def _emit_step_log(self, metrics, step_no: int):
@@ -1529,22 +1580,29 @@ class TpuEngine:
             getattr(self.model, "config", None), "is_moe", False
         )
         if self.monitor:
+            from ..profiling.steptrace import write_events
+
+            # the documented train/* namespace, routed through the
+            # steptrace registry's single monitor bridge (one coherent
+            # scheme with serve/* / comm/* / plan/*)
             events = [
-                ("Train/loss", float(metrics["loss"]), step_no),
-                ("Train/lr", float(metrics["lr"]), step_no),
-                ("Train/grad_norm", float(metrics["grad_norm"]), step_no),
+                ("train/loss", float(metrics["loss"]), step_no),
+                ("train/lr", float(metrics["lr"]), step_no),
+                ("train/grad_norm", float(metrics["grad_norm"]), step_no),
             ]
             if show_moe:
                 events.append((
-                    "Train/moe_aux_loss", float(metrics["moe_aux_loss"]),
+                    "train/moe_aux_loss", float(metrics["moe_aux_loss"]),
                     step_no,
                 ))
             if self.tput.avg_samples_per_sec > 0:
                 events.append((
-                    "Train/samples_per_sec", self.tput.avg_samples_per_sec,
+                    "train/samples_per_sec", self.tput.avg_samples_per_sec,
                     step_no,
                 ))
-            self.monitor.write_events(events)
+            write_events(self.monitor, events)
+            if self.comm_logger is not None:
+                self.comm_logger.write_to(self.monitor, step_no)
         else:
             aux = (
                 f" moe_aux={float(metrics['moe_aux_loss']):.4f}" if show_moe else ""
@@ -1765,6 +1823,51 @@ class TpuEngine:
             jax.block_until_ready(self.state.params)
         log_dist(f"profile_step: xprof trace written to {trace_dir}")
         return loss, trace_dir
+
+    # --------------------------------------------------------- steptrace
+    def enable_tracing(self, max_spans: int = 100_000):
+        """Attach the steptrace registry AFTER construction (bench.py's
+        phase-table leg turns tracing on post-measurement so span fences
+        never perturb the banked number). Equivalent to building with
+        ``{"steptrace": {"enabled": true}}``."""
+        from ..profiling import steptrace as _steptrace
+
+        self.tracer = _steptrace.configure(max_spans=max_spans)
+        if self.comm_logger is not None:
+            self.comm_logger.registry = self.tracer
+        return self.tracer
+
+    def trace_export(self, path: Optional[str] = None) -> str:
+        """Write the Chrome trace-event JSON (Perfetto-loadable; see
+        docs/observability.md). Every declared ``analytic_streams()``
+        stream is added as a ``plan/<name>`` span annotated with its
+        shardplan-predicted bytes/seconds next to the measured average
+        ``train/step`` wall clock — the per-component drift view."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "steptrace is not enabled on this engine — set "
+                '{"steptrace": {"enabled": true}} in the config or call '
+                "enable_tracing() first"
+            )
+        measured = self.tracer.mean_dur("train/step")
+        try:
+            streams = self.analytic_streams(include_potential=True)
+        except Exception:  # noqa: BLE001 — a trace export must not die
+            # on the analytic annotation (e.g. half-built lint shells)
+            streams = {}
+        for name, stream in streams.items():
+            args = {}
+            if name == "offload" and self._bucketed_opt is not None:
+                # bucketed_opt's stream annotation: rotating-slot depth
+                # rides along so Perfetto shows the prefetch structure
+                args = self._bucketed_opt.stream_annotation()
+            self.tracer.plan_span(
+                name, {**stream, **args}, measured_step_s=measured
+            )
+        path = path or self._steptrace_export_path or "steptrace_train.json"
+        out = self.tracer.export(path)
+        log_dist(f"steptrace: wrote {out}")
+        return out
 
     # -- reference imperative protocol ---------------------------------------
     def forward(self, batch):

@@ -55,7 +55,7 @@ from ..models.decoding import (SCALE_LANES, forward_with_cache, init_cache,
 from ..models.sharding import use_topology
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
-from .request import Request, RequestState
+from .request import Request, RequestState, RequestStatus
 from .scheduler import Scheduler, StepPlan
 
 
@@ -303,6 +303,7 @@ class ServingEngine:
         clock=time.monotonic,
         metrics: Optional[ServingMetrics] = None,
         comm_logger=None,
+        steptrace=None,
         **engine_kwargs,
     ):
         from ..config import ServingConfig, _parse_dc
@@ -364,6 +365,26 @@ class ServingEngine:
 
         self.metrics = metrics or ServingMetrics(clock=clock)
         self.metrics.configure(N, num_pages=self.num_pages or 0)
+        # ---- steptrace (config-gated; None = the zero-overhead path:
+        # no span objects exist and every site below guards on it) ------
+        self.tracer = None
+        self._serve_tracer = None
+        self._steptrace_export_path = None
+        if steptrace is not None:
+            from ..config import SteptraceConfig
+
+            stc = (
+                steptrace if isinstance(steptrace, SteptraceConfig)
+                else _parse_dc(SteptraceConfig, steptrace)
+            )
+            stc.validate()
+            if stc.enabled:
+                from ..profiling import steptrace as _steptrace
+
+                self.tracer = _steptrace.configure(max_spans=stc.max_spans)
+                self._serve_tracer = _steptrace.ServeTracer(self.tracer)
+                self.metrics.tracer = self._serve_tracer
+                self._steptrace_export_path = stc.export_path
         self.scheduler = Scheduler(
             max_slots=N,
             token_budget=W,
@@ -442,12 +463,40 @@ class ServingEngine:
     def step(self) -> List[RequestState]:
         """One scheduler plan + one jitted device step. Returns requests
         that FINISHED this step (their slots already recycled)."""
+        tr = self.tracer
+        if tr is None:
+            plan = self.scheduler.plan()
+            if plan is None:
+                return []
+            return self._run_plan(plan)
+        # traced step: serve/step parent; serve/plan, serve/dispatch,
+        # serve/device, serve/complete children cover the whole of it
+        # (tools/trace_report.py --validate checks the coverage)
+        step_sp = tr.begin("serve/step", "serve",
+                           {"step": self.metrics.steps + 1})
+        plan_sp = tr.begin("serve/plan", "serve")
         plan = self.scheduler.plan()
         if plan is None:
+            # idle tick: no device step ran — drop BOTH spans (an orphan
+            # serve/plan with no parent step would skew the phase table)
+            plan_sp.cancel()
+            step_sp.cancel()
             return []
-        return self._run_plan(plan)
+        plan_sp.end()
+        step_sp.annotate(scheduled_tokens=int(plan.total_tokens))
+        try:
+            return self._run_plan(plan)
+        finally:
+            step_sp.end()
 
     def _run_plan(self, plan: StepPlan) -> List[RequestState]:
+        tr = self.tracer
+        # dispatch span covers host-side array staging (the per-slot
+        # numpy fills below, including jnp uploads) + the jit call; the
+        # device span then FENCES on the outputs, so compile time lands
+        # in dispatch (the first-step TTFT spike is visible as such) and
+        # device wait time in device
+        dispatch_sp = tr.begin("serve/dispatch", "serve") if tr else None
         N = self.max_slots
         temp = np.zeros(N, np.float32)
         top_k = np.zeros(N, np.int32)
@@ -481,6 +530,7 @@ class ServingEngine:
                 self.capacity - self.token_budget,
             ).astype(np.int32)
             paged_args = ()
+        traces_before = self.step_traces
         with use_topology(self.topology), self.engine._impl_ctx():
             caches, seen, next_tok, new_rng = self._step(
                 self.engine.params, self._caches, self._seen,
@@ -490,6 +540,21 @@ class ServingEngine:
                 jnp.asarray(rng), jnp.asarray(temp), jnp.asarray(top_k),
                 jnp.asarray(top_p), jnp.asarray(penalty),
             )
+        if dispatch_sp is not None:
+            dispatch_sp.annotate(traced=self.step_traces - traces_before)
+            dispatch_sp.end()
+            device_sp = tr.begin("serve/device", "serve")
+            device_sp.end(fence=next_tok)
+            # prompt chunks fed this step become request-scoped spans
+            # covering the dispatch+device window (statuses read BEFORE
+            # complete() advances them)
+            for w in plan.work:
+                if w.n_tokens > 0 and \
+                        w.state.status is RequestStatus.PREFILL:
+                    self._serve_tracer.on_chunk(
+                        w.state, w.n_tokens, dispatch_sp.t0, device_sp.t1
+                    )
+            complete_sp = tr.begin("serve/complete", "serve")
         self._caches, self._seen = caches, seen
         finished = self.scheduler.complete(
             plan, np.asarray(next_tok), np.asarray(new_rng)
@@ -497,6 +562,8 @@ class ServingEngine:
         self.metrics.on_step()
         if self.comm_logger is not None:
             self.comm_logger.record_streams(self.analytic_streams())
+        if tr is not None:
+            complete_sp.end()
         return finished
 
     def run_until_idle(self, max_steps: int = 100_000
@@ -513,6 +580,28 @@ class ServingEngine:
             finished.extend(self.step())
             steps += 1
         return finished
+
+    # --------------------------------------------------------- steptrace
+    def trace_export(self, path: Optional[str] = None) -> str:
+        """Write the Chrome trace-event JSON (Perfetto-loadable). Before
+        exporting, every declared ``analytic_streams()`` stream is added
+        as a ``plan/<name>`` span carrying its shardplan-predicted
+        bytes/seconds next to the measured average step wall clock —
+        the per-component drift view. Load with ``tools/trace_report.py``
+        for the per-phase table and schema validation."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "steptrace is not enabled on this ServingEngine — pass "
+                'steptrace={"enabled": True} (or set the "steptrace" '
+                "config section) at construction"
+            )
+        measured = self.tracer.mean_dur("serve/step")
+        for name, stream in self.analytic_streams().items():
+            self.tracer.plan_span(name, stream, measured_step_s=measured)
+        path = path or self._steptrace_export_path or "steptrace_serve.json"
+        out = self.tracer.export(path)
+        log_dist(f"steptrace: wrote {out}")
+        return out
 
     # --------------------------------------------------- planner metadata
     def analytic_streams(self, include_potential: bool = False

@@ -35,6 +35,11 @@ class ServingMetrics:
     def __init__(self, clock=time.monotonic):
         self.clock = clock
         self._t0 = clock()
+        # optional steptrace request tracer (profiling/steptrace.py
+        # ServeTracer): the lifecycle hooks below forward to it so a
+        # traced replay gets per-request QUEUED→PREFILL→DECODE→DONE span
+        # trees for free; None (default) is the zero-overhead path
+        self.tracer = None
         # counters
         self.submitted = 0
         self.admitted = 0
@@ -72,11 +77,15 @@ class ServingMetrics:
     def on_submit(self, state, now: float, queue_depth: int = 0) -> None:
         self.submitted += 1
         self.queue_depth = queue_depth
+        if self.tracer is not None:
+            self.tracer.on_submit(state)
 
     def on_admit(self, state, now: float, queue_depth: int = 0) -> None:
         self.admitted += 1
         self.queue_depth = queue_depth
         self.queue_wait_s.append(now - state.arrival_t)
+        if self.tracer is not None:
+            self.tracer.on_admit(state)
 
     def on_evict(self, state, now: float) -> None:
         # graceful admission rejection and timeout eviction both land
@@ -85,6 +94,8 @@ class ServingMetrics:
         if (state.evict_reason or "").startswith("queue full"):
             self.rejected += 1
         self.evict_reasons[state.evict_reason or "unknown"] += 1
+        if self.tracer is not None:
+            self.tracer.on_evict(state)
 
     def on_plan(self, plan, now: float, queue_depth: int = 0,
                 occupancy: int = 0) -> None:
@@ -94,9 +105,13 @@ class ServingMetrics:
 
     def on_token(self, state, now: float) -> None:
         self.tokens_out += 1
+        if self.tracer is not None:
+            self.tracer.on_token(state)
 
     def on_finish(self, state, now: float) -> None:
         self.finished += 1
+        if self.tracer is not None:
+            self.tracer.on_finish(state)
         if state.first_token_t is not None:
             self.ttft_s.append(state.first_token_t - state.arrival_t)
             n = len(state.tokens)
@@ -219,8 +234,13 @@ class ServingMetrics:
         return "\n".join(lines)
 
     def write_to(self, monitor, step: int) -> None:
-        """Feed the monitor/ backends (Monitor.write_events event triples)."""
-        monitor.write_events([
-            (f"Serving/{k}", float(v), step)
+        """Feed the monitor/ backends through the steptrace registry's
+        single ``write_events`` bridge, under the documented ``serve/*``
+        namespace (one coherent scheme with ``train/*``/``comm/*``/
+        ``plan/*`` — docs/observability.md)."""
+        from ..profiling.steptrace import write_events
+
+        write_events(monitor, [
+            (f"serve/{k}", float(v), int(step))
             for k, v in self.snapshot().items()
         ])

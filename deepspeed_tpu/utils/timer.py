@@ -15,6 +15,9 @@ import jax
 from .logging import logger
 
 
+_bare_barrier_warned = False
+
+
 class _Timer:
     def __init__(self, name: str):
         self.name = name
@@ -26,10 +29,38 @@ class _Timer:
         self._start = time.perf_counter()
 
     def stop(self, barrier: bool = False, block_on=None) -> None:
+        """Stop the timer and bank the interval.
+
+        ``block_on`` is how "synchronized" actually happens on TPU:
+        ``jax.block_until_ready(block_on)`` fences BEFORE the host clock
+        is read, so async-dispatched device work is charged to the
+        interval that launched it. Pass the step's outputs (a loss, the
+        new params — anything data-dependent on the timed work).
+
+        A bare ``barrier=True`` with NO ``block_on`` has nothing to
+        fence on — jax has no global device barrier — so it only reads
+        the host clock and silently UNDER-COUNTS async dispatch (the
+        dispatch returns in microseconds while the device still runs).
+        It warns once per process so the under-count is never silent.
+        """
         if self._start is None:
             return
         if block_on is not None:
+            # the actual fence (barrier=True is implied by providing a
+            # value; barrier=False with block_on still fences — callers
+            # passing a value always want device time attributed here)
             jax.block_until_ready(block_on)
+        elif barrier:
+            global _bare_barrier_warned
+            if not _bare_barrier_warned:
+                _bare_barrier_warned = True
+                logger.warning(
+                    f"timer {self.name!r}: stop(barrier=True) without "
+                    "block_on cannot fence device work (no global jax "
+                    "barrier exists) — the reading only covers host "
+                    "time; pass block_on=<step outputs> to charge async "
+                    "dispatch to this timer"
+                )
         self.elapsed_total += time.perf_counter() - self._start
         self.count += 1
         self._start = None

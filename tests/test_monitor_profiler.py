@@ -227,6 +227,60 @@ def test_native_tfevents_writer_roundtrip(tmp_path):
     assert scalars["Train/lr"] > 0
 
 
+def test_monitor_bridge_csv_roundtrip_serve_namespace(tmp_path):
+    """ISSUE 8 satellite: registry events from a traced serving replay
+    land in the CSV backend under the documented ``serve/*`` names with
+    monotone steps — ServingMetrics.write_to routes through the
+    steptrace registry's single ``write_events`` bridge."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama as _llama
+    from deepspeed_tpu.profiling import steptrace
+    from deepspeed_tpu.serving import Request, ServingEngine
+
+    steptrace.reset()
+    try:
+        model = _llama(
+            "llama-tiny", vocab_size=128, max_seq_len=64, hidden_size=32,
+            num_layers=2, num_heads=4, num_kv_heads=2, intermediate_size=64,
+        )
+        eng = deepspeed_tpu.init_inference(
+            model, dtype=jnp.float32, max_tokens=64,
+            rng=jax.random.PRNGKey(0),
+        )
+        srv = ServingEngine(engine=eng, serving={
+            "max_slots": 2, "token_budget": 8, "max_tokens": 64,
+        }, steptrace={"enabled": True})
+        mon = csv_monitor(str(tmp_path), "serve_job")
+        r = np.random.RandomState(0)
+        for i in range(2):
+            srv.submit(Request(request_id=f"r{i}",
+                               prompt=r.randint(0, 128, size=(5,)),
+                               max_new_tokens=2))
+        while srv.scheduler.has_work:
+            srv.step()
+            srv.metrics.write_to(mon, step=srv.metrics.steps)
+        mon.close()
+
+        job = os.path.join(str(tmp_path), "serve_job")
+        files = sorted(os.listdir(job))
+        # documented serve/* namespace (tag / -> filename _), nothing
+        # under the legacy Serving/ prefix
+        assert all(f.startswith("serve_") for f in files)
+        for key in ("serve_tokens_out", "serve_steps", "serve_ttft_p50_s"):
+            assert f"{key}.csv" in files
+        with open(os.path.join(job, "serve_steps.csv")) as f:
+            rows = [(int(a), float(b)) for a, b in csv.reader(f)]
+        steps = [a for a, _ in rows]
+        assert steps == sorted(steps) and len(set(steps)) == len(steps), \
+            "steps must be strictly monotone"
+        assert [b for _, b in rows] == [float(s) for s in steps]
+        # the bridge ALSO recorded every event into the registry
+        reg = steptrace.get_registry()
+        assert any(t.startswith("serve/") for t, *_ in reg.samples)
+    finally:
+        steptrace.reset()
+
+
 def test_overlap_ratio_is_the_single_hardened_path():
     """ISSUE 4 satellite: the generic ``overlap_ratio`` IS the primary
     (one hardened zero/NaN/None path); ``offload_overlap_ratio`` is the

@@ -100,6 +100,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--check-recompiles", action="store_true",
                     help="exit 1 unless the slot step compiled exactly once")
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="enable steptrace and export the replay as Chrome "
+                         "trace-event JSON to PATH (inspect with "
+                         "tools/trace_report.py or Perfetto)")
     ap.add_argument("--paged", action="store_true",
                     help="block-paged KV arena (page pool + per-slot page "
                          "tables + prefix cache) instead of contiguous "
@@ -146,6 +150,10 @@ def main(argv=None) -> int:
         clock=clock,
         metrics=ServingMetrics(clock=clock),
         comm_logger=logger,
+        steptrace=(
+            {"enabled": True, "export_path": args.trace}
+            if args.trace else None
+        ),
         serving={
             "max_slots": args.slots,
             "token_budget": args.token_budget,
@@ -158,6 +166,9 @@ def main(argv=None) -> int:
             "prefix_cache": not args.no_prefix_cache,
         },
     )
+    if args.trace:
+        # the comms logger's stream records land on the same timeline
+        logger.registry = srv.tracer
     trace = build_trace(args)
     pending = list(trace)
     t_wall0 = time.perf_counter()
@@ -205,6 +216,10 @@ def main(argv=None) -> int:
         f"(zero-after-warmup criterion: 1), lockstep engine compiles="
         f"{engine.num_compiles}"
     )
+    if args.trace:
+        out = srv.trace_export(args.trace)
+        print(f"steptrace: wrote {out} "
+              f"(validate/report with tools/trace_report.py)")
     if m["finished"] != args.requests:
         print(f"ERROR: {args.requests - m['finished']} requests unfinished")
         return 1
