@@ -242,6 +242,42 @@ class TensorParallelConfig:
 
 
 @dataclass
+class SpecDecodeConfig:
+    """"serving.spec" section — speculative decoding inside the slot
+    engine (deepspeed_tpu/serving/spec.py, docs/serving.md). Each active
+    decode slot proposes up to ``max_draft`` draft tokens host-side
+    (n-gram/prompt-lookup over its own token buffer); the ONE jitted
+    step verifies every slot's window at once. A spec decode slot
+    consumes ``max_draft + 1`` budget rows; the SplitFuse planner
+    shrinks the draft count toward 0 under budget pressure, so the step
+    shape — and the zero-recompiles contract — never changes. Lossless:
+    spec-on reproduces spec-off token-for-token (greedy AND
+    sampled-with-shared-keys)."""
+
+    enabled: bool = False
+    max_draft: int = 4     # k: draft tokens per decode slot per step (the
+                           # verify window is k+1 rows of the slot's chunk)
+    draft: str = "ngram"   # draft source; "ngram" = host-side n-gram /
+                           # prompt-lookup over the slot's token buffer
+    ngram_n: int = 3       # context length of the n-gram match
+
+    def validate(self) -> None:
+        if int(self.max_draft) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.spec.max_draft must be >= 1, got {self.max_draft}"
+            )
+        if self.draft != "ngram":
+            raise DeepSpeedConfigError(
+                'serving.spec.draft must be "ngram" (host-side n-gram / '
+                f"prompt-lookup), got {self.draft!r}"
+            )
+        if int(self.ngram_n) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.spec.ngram_n must be >= 1, got {self.ngram_n}"
+            )
+
+
+@dataclass
 class ServingConfig:
     """"serving" section — the continuous-batching runtime
     (deepspeed_tpu/serving/). Parity: DeepSpeed-MII / FastGen's
@@ -272,6 +308,16 @@ class ServingConfig:
     prefix_cache: bool = True    # hash-of-prefix → shared read-only pages
                                  # with refcounts + copy-on-write (paged
                                  # mode only)
+    spec: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
+                                 # speculative decoding (draft-then-verify
+                                 # per decode slot); see SpecDecodeConfig
+
+    def __post_init__(self):
+        # _parse_dc is shallow: the nested "spec" section arrives as a
+        # dict both from DeepSpeedConfig and from ServingEngine(serving=
+        # {...}) — normalize it here so every consumer sees the dataclass
+        if isinstance(self.spec, dict):
+            self.spec = _parse_dc(SpecDecodeConfig, self.spec)
 
     def pages_per_slot(self, max_tokens: Optional[int] = None) -> int:
         """Logical pages per slot: covers the per-request token cap plus
@@ -315,6 +361,17 @@ class ServingConfig:
                 f"serving.num_pages must be >= 0 (0 = auto), got "
                 f"{self.num_pages}"
             )
+        if self.spec.enabled:
+            # a disabled spec section is inert (the engine maps it to
+            # max_draft = 0), so its field ranges only matter when on
+            self.spec.validate()
+            if int(self.spec.max_draft) + 1 > int(self.token_budget):
+                raise DeepSpeedConfigError(
+                    f"serving.spec.max_draft {self.spec.max_draft} needs "
+                    f"max_draft + 1 <= token_budget {self.token_budget}: a "
+                    "spec decode slot's verify window is max_draft + 1 rows "
+                    "of the one fixed-shape step"
+                )
         # NOTE: the num_pages liveness floor (num_pages >= pages_per_slot)
         # depends on the ENGINE-clamped max_tokens (min with the model's
         # max_seq_len), so ServingEngine.__init__ / trace_serving_step

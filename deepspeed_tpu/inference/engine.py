@@ -62,11 +62,17 @@ def apply_repetition_penalty(logits, seen, penalty, active=None):
 
     ``active`` ([B] or [B, 1] bool, optional) masks ragged-batch rows:
     padded/inactive slots keep their logits untouched instead of
-    attending whatever stale ``seen`` garbage their row holds."""
+    attending whatever stale ``seen`` garbage their row holds.
+
+    ``logits`` may also be a [B, S, V] verify WINDOW (the serving step's
+    speculative form): the one [B, V] ``seen`` matrix then applies to
+    every window position — same elementwise math, so the S = 1 window
+    is bitwise the 2-D path."""
     penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
-    mask = seen
+    mask = seen if logits.ndim == 2 else seen[:, None, :]
     if active is not None:
-        mask = mask & jnp.reshape(active, (-1, 1))
+        shape = (-1, 1) if logits.ndim == 2 else (-1, 1, 1)
+        mask = mask & jnp.reshape(active, shape)
     return jnp.where(mask, penalized, logits)
 
 
@@ -473,6 +479,12 @@ class InferenceEngine:
         same weight bytes as decoding one, so every accepted draft token
         is nearly free throughput.
 
+        Since ISSUE 9 the draft lookup and the acceptance math live in
+        ``serving/spec.py`` (ngram_propose / longest_accepted_prefix /
+        clamp_advance_at_eos) — ONE implementation shared with the slot
+        engine's batched verify; this builder is the thin lockstep
+        caller.
+
         Shapes are BUCKETED (``prompt_bucket`` at 32, ``total_bucket`` at
         the cache's 128); the actual ``prompt_len``/``total_len`` ride as
         traced operands, so every request whose lengths round to the same
@@ -480,29 +492,15 @@ class InferenceEngine:
         fill; its cache writes sit beyond the frontier and are rewritten
         before any query can attend them.
         """
+        from ..serving.spec import (clamp_advance_at_eos,
+                                    longest_accepted_prefix, ngram_propose)
+
         cfg = self.config
         ngram = isinstance(self.draft_model, str)
         m = int(self.spec_ngram_n)
         dcfg = None if ngram else self.draft_model.config
         # margin so last-round writes stay in-bounds
         total_alloc = total_bucket + k
-
-        def ngram_propose(tokens_buf, pos):
-            """[1, k-1] proposed tokens for positions pos+1..pos+k-1."""
-            buf = tokens_buf[0]
-            idx = jnp.arange(buf.shape[0])
-            # context-end candidates e < pos whose trailing m tokens match
-            # the buffer's trailing m tokens at pos (roll is safe: e >= m-1
-            # >= t keeps every compared index in-bounds, no wraparound)
-            match = (idx >= m - 1) & (idx < pos)
-            for t in range(m):
-                match &= jnp.roll(buf, t) == jnp.take(buf, pos - t)
-            e = jnp.max(jnp.where(match, idx, -1))
-            # fallback: past-pos entries hold the previous rejected
-            # window's verifier predictions — free, plausible proposals
-            start = jnp.where(e >= 0, e + 1, pos + 1)
-            cont = lax.dynamic_slice(buf, (start,), (k - 1,))
-            return cont[None, :].astype(jnp.int32)
 
         def spec_generate(params, dparams, tokens_buf, prompt_len, total_len,
                           eos_id):
@@ -539,9 +537,14 @@ class InferenceEngine:
                 tokens_buf, main_cache, draft_cache, pos, done, rounds = state
                 start_tok = lax.dynamic_slice(tokens_buf, (0, pos), (1, 1))
                 if ngram:
+                    # shared prompt-lookup draft (serving/spec.py): the
+                    # no-match fallback slice past ``pos`` reads the
+                    # previous rejected window's stale verifier
+                    # predictions — free, plausible proposals
                     cand = jnp.concatenate(
                         [start_tok.astype(jnp.int32),
-                         ngram_propose(tokens_buf, pos)], axis=1
+                         ngram_propose(tokens_buf[0], pos, k - 1, m)[None, :]],
+                        axis=1,
                     )
                 else:
                     # --- draft k-1 tokens autoregressively --------------
@@ -586,15 +589,15 @@ class InferenceEngine:
                     main_cache, pos, dtype=self.dtype
                 )
                 targets = jnp.argmax(vlog, axis=-1).astype(jnp.int32)  # [1,k]
-                # longest matching prefix of drafted vs verifier tokens
-                match = cand[0, 1:] == targets[0, : k - 1]  # [k-1]
-                n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
-                adv = n_acc + 1  # accepted drafts + the verifier bonus token
-                # eos inside the accepted span clamps the advance
-                acc_mask = jnp.arange(k) < adv
-                is_eos = (targets[0] == eos_id) & acc_mask
-                has_eos = jnp.any(is_eos)
-                adv = jnp.where(has_eos, jnp.argmax(is_eos) + 1, adv)
+                # shared acceptance math (serving/spec.py): longest
+                # matching draft prefix + the verifier bonus token, the
+                # advance clamped at an emitted eos
+                n_acc = longest_accepted_prefix(
+                    cand[0, 1:] == targets[0, : k - 1]
+                )
+                adv, has_eos = clamp_advance_at_eos(
+                    targets[0], n_acc + 1, eos_id
+                )
                 tokens_buf = lax.dynamic_update_slice(
                     tokens_buf, targets, (0, pos + 1)
                 )

@@ -63,6 +63,26 @@ def _update_scale_at(scale: jax.Array, new: jax.Array, cache_len) -> jax.Array:
     return lax.dynamic_update_slice(scale, new, (0, 0, cache_len, 0))
 
 
+def gather_verify_window(logits: jax.Array, num_new, spec_len,
+                         max_draft: int) -> jax.Array:
+    """Per-row verify-window gather for speculative decoding: of a ragged
+    chunk's logits [B, W, V], pick each row's last ``spec_len + 1`` REAL
+    positions (the committed-token feed plus its drafts), left-aligned
+    into a fixed [B, max_draft + 1, V] window. Rows with ``spec_len = 0``
+    reduce to the single last-real-position gather the plain serving
+    step always did (bitwise — same clip, same take_along_axis); window
+    slots past a row's ``spec_len`` hold clipped garbage the caller
+    masks. ``max_draft`` is static (the ONE step's fixed shape),
+    ``spec_len`` is traced — per-slot draft counts never recompile."""
+    W = logits.shape[1]
+    base = num_new - 1 - spec_len
+    idx = jnp.clip(
+        base[:, None] + jnp.arange(max_draft + 1, dtype=jnp.int32)[None, :],
+        0, W - 1,
+    )
+    return jnp.take_along_axis(logits, idx[:, :, None], axis=1)
+
+
 def init_paged_cache(cfg: TransformerConfig, num_pages: int, page_size: int,
                      dtype=jnp.bfloat16, quantized: bool = False) -> Cache:
     """Block-paged KV pool for all layers (the serving engine's paged

@@ -503,6 +503,16 @@ class ServeTracer:
                              args={"tokens": int(n_tokens)}, t=t0)
         self.reg.async_end(f"PREFILL chunk {i}", self.CAT, rid, t=t1)
 
+    def on_spec(self, state, proposed: int, accepted: int) -> None:
+        """One speculative verify window (instant event on the request's
+        track): how many drafts this slot proposed and how many the
+        verifier accepted — the per-request acceptance trace next to the
+        ``serve/step`` spans' ``spec_draft_tokens`` annotation."""
+        self.reg.instant(
+            "SPEC verify", self.CAT, self._rid(state),
+            args={"proposed": int(proposed), "accepted": int(accepted)},
+        )
+
     def on_token(self, state) -> None:
         if len(state.tokens) != 1:
             return  # only the FIRST token flips PREFILL -> DECODE

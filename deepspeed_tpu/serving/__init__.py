@@ -13,6 +13,8 @@ from .metrics import ServingMetrics
 from .paging import PagePool, PrefixCache
 from .request import Request, RequestState, RequestStatus, request_rng
 from .scheduler import Scheduler, StepPlan
+from .spec import (clamp_advance_at_eos, longest_accepted_prefix,
+                   ngram_propose, propose_drafts, verify_window)
 
 __all__ = [
     "PagePool",
@@ -24,8 +26,13 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "StepPlan",
+    "clamp_advance_at_eos",
+    "longest_accepted_prefix",
     "make_paged_step_fn",
     "make_step_fn",
+    "ngram_propose",
+    "propose_drafts",
     "request_rng",
     "trace_serving_step",
+    "verify_window",
 ]

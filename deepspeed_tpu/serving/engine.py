@@ -35,6 +35,14 @@ into the step via a ``cow_src`` vector — same ONE-jitted-step
 discipline, outputs bitwise identical to the contiguous arena (see
 docs/serving.md "Block-paged, prefix-shared arena" and
 tests/test_serving_paged.py).
+
+``serving.spec`` adds **speculative decoding** (serving/spec.py): each
+decode slot's row may carry up to ``max_draft`` host-proposed n-gram
+drafts after its committed token (a spec slot claims k+1 budget rows),
+the step verifies every window at once and emits 1..k+1 tokens per slot
+(``out_tokens``/``n_emit``), and sample-and-match acceptance against the
+per-slot RNG chain keeps spec-on output bitwise identical to spec-off —
+see docs/serving.md "Speculative decoding" and tests/test_serving_spec.py.
 """
 
 from __future__ import annotations
@@ -49,7 +57,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.topology import MeshTopology, ParallelDims
 from ..inference.engine import (InferenceEngine, _align_cache,
-                                apply_repetition_penalty, init_inference)
+                                init_inference)
 from ..models.decoding import (SCALE_LANES, forward_with_cache, init_cache,
                                init_paged_cache, paged_cow_copy)
 from ..models.sharding import use_topology
@@ -57,6 +65,7 @@ from ..utils.logging import log_dist
 from .metrics import ServingMetrics
 from .request import Request, RequestState, RequestStatus
 from .scheduler import Scheduler, StepPlan
+from .spec import spec_verify_stream, verify_window
 
 
 def cache_partition_specs(quantized: bool) -> Dict[str, P]:
@@ -132,13 +141,6 @@ def _make_sample_one(vocab: int):
     return sample_one
 
 
-def _advance_rng(key, flag):
-    pair = jax.random.split(key)  # [2, 2]: (sample key, next chain)
-    use = jnp.broadcast_to(flag, key.shape)
-    return (jnp.where(use, pair[0], key),
-            jnp.where(use, pair[1], key))
-
-
 def paged_kv_stream(cfg, num_pages: int, page_size: int, max_slots: int,
                     pages_per_slot: int, token_budget: int,
                     storage_itemsize: int, quantized: bool,
@@ -175,26 +177,42 @@ def paged_kv_stream(cfg, num_pages: int, page_size: int, max_slots: int,
     }
 
 
-def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
+def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
+                 max_draft: int = 0):
     """The ONE serving step (pure; jitted by ServingEngine, traced
     abstractly by the shardlint serving branch).
 
     Inputs (fixed shapes; N = max_slots, W = token_budget):
-      tokens [N, W] int32   chunk tokens, 0-padded past ``num_new``
+      tokens [N, W] int32   chunk tokens, 0-padded past ``num_new``; a
+                            spec decode slot's row is its committed token
+                            followed by ``spec_len`` drafts
       num_new [N] int32     real tokens per slot (0 = idle slot)
       start_pos [N] int32   per-slot write frontier (== cached tokens)
       fresh [N] bool        slot newly allocated → clear its seen row
-      sample_flag [N] bool  slot samples a token this step
-      rng [N, 2] uint32     per-slot PRNG keys (split ONLY when sampling,
-                            mirroring the lockstep engine's chain)
+      sample_flag [N] bool  slot samples this step
+      spec_len [N] int32    draft tokens in the row's verify window
+                            (0 = plain decode / final prefill feed)
+      eos_id [N] int32      per-request eos (-1 = none): the verify
+                            advance clamps at an emitted eos so the RNG
+                            chain stops exactly where spec-off would
+      rng [N, 2] uint32     per-slot PRNG keys (split ONLY when a token
+                            is emitted, mirroring the lockstep chain)
       temperature/top_p/rep_penalty [N] f32, top_k [N] i32
+
+    ``max_draft`` is STATIC (the step's fixed output shape
+    [N, max_draft + 1]); 0 disables speculation and reduces the verify
+    window to the pre-spec single-token sampling tail, bitwise.
+
+    Returns (caches, seen, out_tokens [N, max_draft + 1] i32,
+    n_emit [N] i32, new_rng [N, 2]).
     """
     sample_one = _make_sample_one(vocab)
 
     def step(params, caches, seen, tokens, num_new, start_pos, fresh,
-             sample_flag, rng, temperature, top_k, top_p, rep_penalty):
+             sample_flag, spec_len, eos_id, rng, temperature, top_k, top_p,
+             rep_penalty):
         live = sample_flag & (num_new > 0)
-        seen = _book_seen(seen, tokens, num_new, fresh, vocab)
+        seen = _book_seen(seen, tokens, num_new, spec_len, fresh, vocab)
         logits, caches = forward_with_cache(
             cfg, params, tokens, caches, start_pos, dtype=dtype
         )
@@ -203,49 +221,35 @@ def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
             caches = jax.lax.with_sharding_constraint(
                 caches, cache_shardings
             )
-        next_tok, new_rng = _sample_tail(
-            sample_one, logits, seen, num_new, live, rng,
-            temperature, top_k, top_p, rep_penalty,
+        out_tok, n_emit, new_rng = verify_window(
+            sample_one, logits, tokens, seen, num_new, spec_len, live, rng,
+            temperature, top_k, top_p, rep_penalty, eos_id, max_draft,
         )
-        return caches, seen, next_tok, new_rng
+        return caches, seen, out_tok, n_emit, new_rng
 
     return step
 
 
-def _book_seen(seen, tokens, num_new, fresh, vocab):
+def _book_seen(seen, tokens, num_new, spec_len, fresh, vocab):
     """seen bookkeeping BEFORE the forward, exactly where the lockstep
     engine books tokens (prompt before the first sample, each fed token
     before its successor samples); fresh slots reset first and padded
-    positions never book (the ragged-batch hazard fix)."""
+    positions never book (the ragged-batch hazard fix). DRAFT tokens
+    (the last ``spec_len`` of a row) never book either: they are
+    speculative, and spec is host-gated to repetition_penalty == 1.0
+    requests whose ``seen`` row is never consulted — so the matrix only
+    ever holds committed-fed tokens."""
     N, W = tokens.shape
     rows = jnp.arange(N)
     seen = jnp.where(fresh[:, None], jnp.zeros_like(seen), seen)
-    valid = jnp.arange(W)[None, :] < num_new[:, None]
+    valid = jnp.arange(W)[None, :] < (num_new - spec_len)[:, None]
     return seen.at[
         rows[:, None], jnp.clip(tokens, 0, vocab - 1)
     ].max(valid)
 
 
-def _sample_tail(sample_one, logits, seen, num_new, live, rng,
-                 temperature, top_k, top_p, rep_penalty):
-    """Each slot's last REAL token's logits → one sampled token per live
-    slot (idle slots read row 0 — garbage, masked out by ``live``)."""
-    W = logits.shape[1]
-    idx = jnp.clip(num_new - 1, 0, W - 1)
-    last = jnp.take_along_axis(
-        logits, idx[:, None, None], axis=1
-    )[:, 0]  # [N, V]
-    last = apply_repetition_penalty(
-        last, seen, rep_penalty[:, None], active=live
-    )
-    keys, new_rng = jax.vmap(_advance_rng)(rng, live)
-    next_tok = jax.vmap(sample_one)(
-        last, keys, temperature, top_k, top_p
-    ).astype(jnp.int32)
-    return next_tok, new_rng
-
-
-def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
+def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
+                       max_draft: int = 0):
     """Paged twin of :func:`make_step_fn`: same fixed [N, W] discipline,
     two extra traced int32 inputs instead of per-slot cache regions —
 
@@ -265,10 +269,10 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
     sample_one = _make_sample_one(vocab)
 
     def step(params, caches, seen, tokens, num_new, start_pos, page_table,
-             cow_src, fresh, sample_flag, rng, temperature, top_k, top_p,
-             rep_penalty):
+             cow_src, fresh, sample_flag, spec_len, eos_id, rng, temperature,
+             top_k, top_p, rep_penalty):
         live = sample_flag & (num_new > 0)
-        seen = _book_seen(seen, tokens, num_new, fresh, vocab)
+        seen = _book_seen(seen, tokens, num_new, spec_len, fresh, vocab)
         caches = paged_cow_copy(caches, page_table, start_pos, cow_src)
         logits, caches = forward_with_cache(
             cfg, params, tokens, caches, start_pos, dtype=dtype,
@@ -279,11 +283,11 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None):
             caches = jax.lax.with_sharding_constraint(
                 caches, cache_shardings
             )
-        next_tok, new_rng = _sample_tail(
-            sample_one, logits, seen, num_new, live, rng,
-            temperature, top_k, top_p, rep_penalty,
+        out_tok, n_emit, new_rng = verify_window(
+            sample_one, logits, tokens, seen, num_new, spec_len, live, rng,
+            temperature, top_k, top_p, rep_penalty, eos_id, max_draft,
         )
-        return caches, seen, next_tok, new_rng
+        return caches, seen, out_tok, n_emit, new_rng
 
     return step
 
@@ -332,6 +336,14 @@ class ServingEngine:
 
         N, W = serving.max_slots, serving.token_budget
         self.max_slots, self.token_budget = N, W
+        # speculative decoding (serving.spec): per-slot draft-then-verify
+        # in the ONE step. max_draft is STATIC (the verify-window output
+        # shape); per-slot/per-step draft counts ride as the traced
+        # spec_len vector, so spec never adds a compile.
+        spec_cfg = serving.spec
+        self.spec_enabled = bool(getattr(spec_cfg, "enabled", False))
+        self.max_draft = int(spec_cfg.max_draft) if self.spec_enabled else 0
+        self.spec_ngram_n = int(getattr(spec_cfg, "ngram_n", 3))
         # per-request cap; the +W margin absorbs the chunk a full slot
         # writes past its frontier (padding rows, never attendable)
         self.max_tokens = min(serving.max_tokens, engine.max_tokens)
@@ -398,6 +410,8 @@ class ServingEngine:
             num_pages=self.num_pages if self.paged else None,
             pages_per_slot=self.pages_per_slot if self.paged else None,
             prefix_cache=bool(serving.prefix_cache) if self.paged else False,
+            spec_max_draft=self.max_draft,
+            spec_ngram_n=self.spec_ngram_n,
         )
 
         # ---- the KV arena (contiguous slots, or a paged pool) ----------
@@ -434,6 +448,7 @@ class ServingEngine:
         step_fn = make_fn(
             self.config, self.dtype, self.config.vocab_size,
             cache_shardings=self._cache_shardings,
+            max_draft=self.max_draft,
         )
         # the recompile counter: a trace-time side effect fires once per
         # XLA compile — the zero-recompiles-after-warmup assertion
@@ -452,7 +467,8 @@ class ServingEngine:
         log_dist(
             f"ServingEngine: slots={N}, token_budget={W}, {arena}, kv="
             f"{'int8' if engine.kv_cache_quantized else jnp.dtype(engine.kv_cache_storage_dtype).name}, "
-            f"tp={self.topology.tp_size}"
+            f"tp={self.topology.tp_size}, spec="
+            f"{f'ngram(k<={self.max_draft})' if self.max_draft else 'off'}"
         )
 
     # ------------------------------------------------------------- intake
@@ -484,6 +500,10 @@ class ServingEngine:
             return []
         plan_sp.end()
         step_sp.annotate(scheduled_tokens=int(plan.total_tokens))
+        if plan.spec_len is not None and plan.spec_len.any():
+            # spec observability: how many of this step's budget rows are
+            # draft (verify-window) rows — trace_report shows it per step
+            step_sp.annotate(spec_draft_tokens=int(plan.spec_len.sum()))
         try:
             return self._run_plan(plan)
         finally:
@@ -502,6 +522,7 @@ class ServingEngine:
         top_k = np.zeros(N, np.int32)
         top_p = np.ones(N, np.float32)
         penalty = np.ones(N, np.float32)
+        eos = np.full(N, -1, np.int32)
         rng = np.zeros((N, 2), np.uint32)
         for w in plan.work:
             req = w.state.request
@@ -509,7 +530,12 @@ class ServingEngine:
             top_k[w.slot] = req.top_k
             top_p[w.slot] = req.top_p
             penalty[w.slot] = req.repetition_penalty
+            eos[w.slot] = req.eos_token_id
             rng[w.slot] = np.asarray(w.state.rng, np.uint32)
+        spec_len = (
+            plan.spec_len if plan.spec_len is not None
+            else np.zeros(N, np.int32)
+        )
         if self.paged:
             # idle rows need no dead-tail repoint: the scheduler hands
             # them an all-NULL page-table row, so their padded W-wide
@@ -532,11 +558,12 @@ class ServingEngine:
             paged_args = ()
         traces_before = self.step_traces
         with use_topology(self.topology), self.engine._impl_ctx():
-            caches, seen, next_tok, new_rng = self._step(
+            caches, seen, out_tok, n_emit, new_rng = self._step(
                 self.engine.params, self._caches, self._seen,
                 jnp.asarray(plan.tokens), jnp.asarray(plan.num_new),
                 jnp.asarray(start_pos), *paged_args,
                 jnp.asarray(plan.fresh), jnp.asarray(plan.sample),
+                jnp.asarray(spec_len), jnp.asarray(eos),
                 jnp.asarray(rng), jnp.asarray(temp), jnp.asarray(top_k),
                 jnp.asarray(top_p), jnp.asarray(penalty),
             )
@@ -544,7 +571,7 @@ class ServingEngine:
             dispatch_sp.annotate(traced=self.step_traces - traces_before)
             dispatch_sp.end()
             device_sp = tr.begin("serve/device", "serve")
-            device_sp.end(fence=next_tok)
+            device_sp.end(fence=out_tok)
             # prompt chunks fed this step become request-scoped spans
             # covering the dispatch+device window (statuses read BEFORE
             # complete() advances them)
@@ -557,7 +584,8 @@ class ServingEngine:
             complete_sp = tr.begin("serve/complete", "serve")
         self._caches, self._seen = caches, seen
         finished = self.scheduler.complete(
-            plan, np.asarray(next_tok), np.asarray(new_rng)
+            plan, np.asarray(out_tok), np.asarray(new_rng),
+            n_emit=np.asarray(n_emit),
         )
         self.metrics.on_step()
         if self.comm_logger is not None:
@@ -628,6 +656,15 @@ class ServingEngine:
                 self.engine.kv_cache_quantized,
                 tp=self.topology.tp_size,
             )
+        if self.max_draft > 0:
+            # the verify-window bytes spec adds on top of the arena
+            # traffic — declared so shardplan R8 prices spec statically
+            streams["spec_verify"] = spec_verify_stream(
+                self.config, self.max_slots, self.max_draft,
+                jnp.dtype(self.engine.kv_cache_storage_dtype).itemsize,
+                self.engine.kv_cache_quantized,
+                tp=self.topology.tp_size,
+            )
         return streams
 
 
@@ -661,6 +698,9 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     V = mcfg.vocab_size
     max_tokens = min(int(srv.max_tokens), mcfg.max_seq_len)
     capacity = _align_cache(max_tokens + W)
+    max_draft = (
+        int(srv.spec.max_draft) if getattr(srv.spec, "enabled", False) else 0
+    )
 
     sharded = topology.world_size > 1 and hasattr(model, "partition_specs")
 
@@ -730,6 +770,8 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         *paged_args,
         sds((N,), jnp.bool_, P()),
         sds((N,), jnp.bool_, P()),
+        sds((N,), jnp.int32, P()),      # spec_len
+        sds((N,), jnp.int32, P()),      # eos_id
         sds((N, 2), jnp.uint32, P()),
         sds((N,), jnp.float32, P()),
         sds((N,), jnp.int32, P()),
@@ -737,7 +779,8 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         sds((N,), jnp.float32, P()),
     )
     make_fn = make_paged_step_fn if paged else make_step_fn
-    step_fn = make_fn(mcfg, dtype, V, cache_shardings=cache_shardings)
+    step_fn = make_fn(mcfg, dtype, V, cache_shardings=cache_shardings,
+                      max_draft=max_draft)
     with use_topology(topology):
         closed = jax.make_jaxpr(step_fn)(*args)
     flat = jax.tree_util.tree_leaves(args)
@@ -762,4 +805,9 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
                 tp=tp,
             )
         }
+    if max_draft > 0:
+        streams["spec_verify"] = spec_verify_stream(
+            mcfg, N, max_draft, jnp.dtype(storage).itemsize, quantized,
+            tp=tp,
+        )
     return closed, arg_shardings, streams

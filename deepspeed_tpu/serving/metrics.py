@@ -9,10 +9,18 @@ Glossary (docs/serving.md):
 
 - **TTFT** — time to first token: first sampled token minus arrival.
 - **TPOT** — time per output token: (finish - first token) / (tokens - 1)
-  for requests that produced more than one token.
+  for requests that produced more than one token. The denominator is
+  TOKENS ACTUALLY EMITTED, never decode steps: with speculative decoding
+  a step emits 1..k+1 tokens per slot and ``on_token`` fires once per
+  emitted token, so spec-on TPOT (and tokens/s) stay honest.
 - **queue depth** — requests admitted but not yet slotted (gauge).
 - **slot occupancy** — in-flight requests / max_slots (gauge).
 - **tokens/s** — sampled tokens over the engine-step window.
+- **acceptance rate** — accepted draft tokens / proposed draft tokens
+  (speculative decoding; 0.0 with spec off).
+- **mean accepted tokens/step** — tokens emitted per verify window
+  (accepted drafts + the bonus token); 1.0 means no draft ever accepted,
+  > 1 is the speculative speedup multiplier on decode steps.
 """
 
 from __future__ import annotations
@@ -58,6 +66,13 @@ class ServingMetrics:
         self.prefill_chunks = 0       # scheduled prompt chunks (a fully-
         #   cached prompt's lone final-token feed does not count)
         self.cached_tail_feeds = 0    # those excluded final-token feeds
+        # speculative decoding
+        self.spec_steps = 0           # verify windows executed (slot-steps
+        #   that carried >= 1 draft row)
+        self.draft_tokens_proposed = 0
+        self.draft_tokens_accepted = 0
+        self.spec_tokens_out = 0      # tokens emitted by verify windows
+        #   (accepted drafts + bonus tokens)
         # gauges (last observed)
         self.queue_depth = 0
         self.slot_occupancy = 0.0
@@ -104,9 +119,24 @@ class ServingMetrics:
         self.scheduled_tokens += plan.total_tokens
 
     def on_token(self, state, now: float) -> None:
+        """One EMITTED token (fires once per token, not per step — a
+        speculative verify window calls this 1..k+1 times, keeping
+        tokens/s and TPOT divided by tokens actually emitted)."""
         self.tokens_out += 1
         if self.tracer is not None:
             self.tracer.on_token(state)
+
+    def on_spec(self, state, proposed: int, accepted: int,
+                emitted: int) -> None:
+        """One executed verify window: ``proposed`` draft rows scheduled,
+        ``accepted`` drafts matched the verifier's targets, ``emitted``
+        = accepted + the bonus token (possibly eos-clamped)."""
+        self.spec_steps += 1
+        self.draft_tokens_proposed += int(proposed)
+        self.draft_tokens_accepted += int(accepted)
+        self.spec_tokens_out += int(emitted)
+        if self.tracer is not None:
+            self.tracer.on_spec(state, proposed, accepted)
 
     def on_finish(self, state, now: float) -> None:
         self.finished += 1
@@ -152,6 +182,24 @@ class ServingMetrics:
             if self.prompt_tokens_seen else 0.0
         )
 
+    @property
+    def acceptance_rate(self) -> float:
+        """Accepted draft tokens over proposed draft tokens (0.0 before
+        any verify window ran)."""
+        return (
+            self.draft_tokens_accepted / self.draft_tokens_proposed
+            if self.draft_tokens_proposed else 0.0
+        )
+
+    @property
+    def mean_accepted_tokens_per_step(self) -> float:
+        """Tokens emitted per verify window (accepted drafts + bonus);
+        1.0 = no acceptance, 0.0 before any window ran."""
+        return (
+            self.spec_tokens_out / self.spec_steps if self.spec_steps
+            else 0.0
+        )
+
     # --------------------------------------------------- engine hooks
     def configure(self, max_slots: int, num_pages: int = 0) -> None:
         self._max_slots = max(int(max_slots), 1)
@@ -195,6 +243,12 @@ class ServingMetrics:
             "pages_in_use": self.pages_in_use,
             "arena_utilization": self.arena_utilization,
             "prefix_cache_entries": self.prefix_cache_entries,
+            "spec_steps": self.spec_steps,
+            "draft_tokens_proposed": self.draft_tokens_proposed,
+            "draft_tokens_accepted": self.draft_tokens_accepted,
+            "acceptance_rate": self.acceptance_rate,
+            "mean_accepted_tokens_per_step":
+                self.mean_accepted_tokens_per_step,
         }
 
     def summary(self) -> str:
@@ -225,6 +279,15 @@ class ServingMetrics:
                 f"cow_copies={self.cow_copies}, "
                 f"prefill_chunks={self.prefill_chunks} "
                 f"(+{self.cached_tail_feeds} cached-tail feeds)"
+            )
+        if self.spec_steps:
+            lines.append(
+                f"{'speculative':<18}acceptance "
+                f"{self.acceptance_rate:.2f} "
+                f"({self.draft_tokens_accepted}/"
+                f"{self.draft_tokens_proposed} drafts), mean accepted "
+                f"tokens/step {self.mean_accepted_tokens_per_step:.2f} "
+                f"over {self.spec_steps} verify windows"
             )
         if self.evict_reasons:
             reasons = ", ".join(

@@ -107,6 +107,11 @@ class RequestState:
     #   prefix-cache refs) — a write into one triggers copy-on-write
     owned_from: int = 0          # first logical page this request owns
     cached_tokens: int = 0       # prompt tokens skipped via the prefix cache
+    # ---- speculative decoding (serving/spec.py) -----------------------
+    draft_tail: List[int] = field(default_factory=list)  # the previous
+    #   verify window's REJECTED targets: stale-but-plausible verifier
+    #   predictions that seed the next n-gram draft's no-match fallback
+    #   (never emitted; cleared on eviction rollback)
 
     def __post_init__(self):
         if self.rng is None:

@@ -6,10 +6,14 @@ engine step gets a :class:`StepPlan` of fixed shape
 
 1. **Token budget** — at most ``token_budget`` REAL tokens are scheduled
    per step (sum of per-slot ``num_new``). Decode slots are served first
-   (one token each — they are latency-critical and starving them inflates
-   every in-flight request's TPOT); leftover budget goes to prompt chunks
-   FCFS, so long prompts "split" across steps and "fuse" with running
-   decodes instead of monopolizing a step.
+   (one committed feed each — they are latency-critical and starving them
+   inflates every in-flight request's TPOT); with speculative decoding on
+   (serving.spec) each decode slot then claims up to ``max_draft`` extra
+   DRAFT rows — a spec slot costs ``k + 1`` budget rows, and under
+   pressure ``k`` shrinks toward 0 (plain decode) before any slot loses
+   its feed; leftover budget goes to prompt chunks FCFS, so long prompts
+   "split" across steps and "fuse" with running decodes instead of
+   monopolizing a step.
 2. **Frontier** — a slot's ``start_pos`` always equals its cached token
    count; the engine writes the chunk there, so cache contents beyond a
    slot's frontier are never attendable (see models/decoding.py).
@@ -33,6 +37,7 @@ import numpy as np
 from ..utils.logging import log_dist
 from .paging import PagePool, PrefixCache
 from .request import Request, RequestState, RequestStatus
+from .spec import propose_drafts
 
 
 @dataclass
@@ -41,8 +46,10 @@ class ScheduledWork:
 
     slot: int
     state: RequestState
-    n_tokens: int          # real tokens fed this step
-    sample: bool           # does this step produce a token for the slot?
+    n_tokens: int          # real tokens fed this step (committed + drafts)
+    sample: bool           # does this step produce tokens for the slot?
+    spec_len: int = 0      # draft tokens in the row's verify window: the
+    #   slot emits 1..spec_len+1 tokens this step depending on acceptance
 
 
 @dataclass
@@ -60,6 +67,8 @@ class StepPlan:
     #   idle rows) point at the NULL sink page
     cow_src: Optional[np.ndarray] = None     # [max_slots] int32 physical
     #   page to copy-on-write onto the slot's frontier page (-1 = none)
+    spec_len: Optional[np.ndarray] = None    # [max_slots] int32 draft
+    #   tokens per row (speculative decoding; None/zeros = plain)
     work: List[ScheduledWork] = field(default_factory=list)
 
     @property
@@ -82,6 +91,8 @@ class Scheduler:
         num_pages: Optional[int] = None,
         pages_per_slot: Optional[int] = None,
         prefix_cache: bool = False,
+        spec_max_draft: int = 0,
+        spec_ngram_n: int = 3,
     ):
         self.max_slots = int(max_slots)
         self.token_budget = int(token_budget)
@@ -97,6 +108,12 @@ class Scheduler:
         self._fresh: set = set()  # slots allocated since their first step
         self._decode_rr = 0  # rotating decode start: fairness when the
                              # token budget cannot cover every decode slot
+        # ---- speculative decoding (serving.spec): each decode slot may
+        # claim up to spec_max_draft draft rows on top of its committed
+        # feed — a spec slot costs k+1 budget rows; under pressure k
+        # shrinks toward 0 (plain decode) before any slot loses its feed
+        self.spec_max_draft = int(spec_max_draft)
+        self.spec_ngram_n = int(spec_ngram_n)
         # ---- block-paged arena bookkeeping (host side; the device only
         # sees the per-step page_table / cow_src int32 vectors) ----------
         self.paged = page_size is not None
@@ -180,6 +197,7 @@ class Scheduler:
             # a retried request still reproduces its deterministic output
             state.prompt_pos = 0
             state.tokens = []
+            state.draft_tail = []
             state.rng = state.request.rng_key()
             state.first_token_t = None  # the retry's TTFT is its own
         if self.metrics is not None:
@@ -395,12 +413,14 @@ class Scheduler:
                 if self.paged else None
             ),
             cow_src=np.full(N, -1, np.int32) if self.paged else None,
+            spec_len=np.zeros(N, np.int32),
         )
         budget = W
-        # decodes first: latency-critical, one token each. The scan starts
-        # at a ROTATING index so a budget smaller than the decode count
-        # round-robins across steps instead of deterministically starving
-        # the high-index slots.
+        # decodes first: latency-critical, one committed feed each. The
+        # scan starts at a ROTATING index so a budget smaller than the
+        # decode count round-robins across steps instead of
+        # deterministically starving the high-index slots.
+        decodes: List[list] = []  # [slot, state, pos, cow, k]
         for off in range(N):
             slot = (self._decode_rr + off) % N
             state = self.slots[slot]
@@ -408,23 +428,41 @@ class Scheduler:
                 continue
             if budget < 1:
                 break
-            tok = state.tokens[-1]
             pos = state.prompt_len + len(state.tokens) - 1
             cow = -1
             if self.paged:
                 ok, cow = self._prepare_pages(state, pos, 1)
                 if ok < 1:
                     continue  # page pressure: this decode waits a step
-            plan.tokens[slot, 0] = tok
-            plan.num_new[slot] = 1
+            decodes.append([slot, state, pos, cow, 0])
+            budget -= 1
+        self._decode_rr = (self._decode_rr + 1) % N
+        # speculative drafts ride WITH the decode pass: a spec slot's row
+        # claims k+1 budget rows (committed feed + k drafts), assigned
+        # round-robin one draft at a time so budget pressure shrinks k
+        # toward 0 uniformly — plain decode is the graceful floor, and the
+        # step shape never changes
+        if self.spec_max_draft > 0 and budget > 0 and decodes:
+            budget = self._assign_drafts(decodes, budget)
+        for slot, state, pos, cow, k in decodes:
+            row = [state.tokens[-1]]
+            if k > 0:
+                drafts = propose_drafts(
+                    state.request.prompt, state.tokens, state.draft_tail,
+                    k, self.spec_ngram_n,
+                )
+                row.extend(int(t) for t in drafts)
+            n = len(row)
+            plan.tokens[slot, :n] = row
+            plan.num_new[slot] = n
             plan.start_pos[slot] = pos
             plan.sample[slot] = True
+            plan.spec_len[slot] = n - 1
             if self.paged:
                 plan.cow_src[slot] = cow
                 plan.page_table[slot, :len(state.pages)] = state.pages
-            plan.work.append(ScheduledWork(slot, state, 1, True))
-            budget -= 1
-        self._decode_rr = (self._decode_rr + 1) % N
+            plan.work.append(ScheduledWork(slot, state, n, True,
+                                           spec_len=n - 1))
         # leftover budget to prompt chunks, FCFS by prefill start
         prefills = sorted(
             (
@@ -474,12 +512,64 @@ class Scheduler:
             return None
         return plan
 
+    def _assign_drafts(self, decodes: List[list], budget: int) -> int:
+        """Distribute leftover budget as draft rows over the scheduled
+        decode slots, one draft per slot per round (round-robin in the
+        same rotating order as the feed pass), until every slot hits its
+        cap or the budget runs out. Caps: ``spec_max_draft``, the
+        request's remaining token allowance minus one (the device then
+        never emits past ``max_new_tokens``, which keeps the RNG chain
+        exactly where spec-off would leave it), and — paged — the pages
+        actually allocatable for the widened window (pool pressure
+        shrinks k instead of failing; pages stay slot-owned on
+        rejection, so rollback never leaks). Requests with
+        ``repetition_penalty != 1.0`` never draft: their ``seen`` matrix
+        is built from fed tokens and accepted spec tokens are never
+        re-fed — correctness over speed, same as the prefix-cache
+        bypass."""
+        grew = True
+        while budget > 0 and grew:
+            grew = False
+            for item in decodes:
+                if budget < 1:
+                    break
+                slot, state, pos, cow, k = item
+                req = state.request
+                if req.repetition_penalty != 1.0:
+                    continue
+                cap = min(
+                    self.spec_max_draft,
+                    req.max_new_tokens - len(state.tokens) - 1,
+                    self.token_budget - 1,
+                )
+                if k >= cap:
+                    continue
+                if self.paged:
+                    ok, _ = self._prepare_pages(state, pos, k + 2)
+                    if ok < k + 2:
+                        continue  # page pressure: this slot stops growing
+                item[4] = k + 1
+                budget -= 1
+                grew = True
+        return budget
+
     # ---------------------------------------------------------- complete
     def complete(self, plan: StepPlan, next_tokens: np.ndarray,
-                 new_rng: Optional[np.ndarray] = None
+                 new_rng: Optional[np.ndarray] = None,
+                 n_emit: Optional[np.ndarray] = None
                  ) -> List[RequestState]:
         """Fold one executed step back into request state. Returns the
-        requests that finished this step (slots already recycled)."""
+        requests that finished this step (slots already recycled).
+
+        ``next_tokens`` is the engine's verify-window output
+        ``[max_slots, max_draft + 1]`` with ``n_emit`` tokens emitted
+        per sampling slot (speculative decoding: accepted drafts + the
+        bonus token advance a slot by >1 per step). The legacy 1-D form
+        ``[max_slots]`` (one token per sampling slot) is still accepted —
+        scheduler unit tests and pre-spec callers pass that."""
+        next_tokens = np.asarray(next_tokens)
+        if next_tokens.ndim == 1:
+            next_tokens = next_tokens[:, None]
         now = self.clock()
         finished: List[RequestState] = []
         for w in plan.work:
@@ -488,25 +578,51 @@ class Scheduler:
                 st.prompt_pos += w.n_tokens
             if not w.sample:
                 continue
-            tok = int(next_tokens[w.slot])
+            n = int(n_emit[w.slot]) if n_emit is not None else 1
             if new_rng is not None:
                 st.rng = new_rng[w.slot]
-            if st.first_token_t is None:
-                st.first_token_t = now
-            st.tokens.append(tok)
-            if st.status is RequestStatus.PREFILL:
-                st.transition(RequestStatus.DECODE)
             req = st.request
-            hit_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
-            if hit_eos or len(st.tokens) >= req.max_new_tokens:
-                st.transition(RequestStatus.DONE)
-                st.finish_t = now
-                # finished requests publish their pages to the prefix
-                # cache (paged arena) before the slot recycles
-                self.release(st.slot, insert_prefix=True)
-                finished.append(st)
-            if self.metrics is not None:
-                self.metrics.on_token(st, now)
+            emitted = 0
+            for j in range(n):
+                tok = int(next_tokens[w.slot, j])
+                if st.first_token_t is None:
+                    st.first_token_t = now
+                st.tokens.append(tok)
+                emitted += 1
+                if st.status is RequestStatus.PREFILL:
+                    st.transition(RequestStatus.DECODE)
+                if self.metrics is not None:
+                    self.metrics.on_token(st, now)
+                hit_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
+                if hit_eos or len(st.tokens) >= req.max_new_tokens:
+                    st.transition(RequestStatus.DONE)
+                    st.finish_t = now
+                    # finished requests publish their pages to the prefix
+                    # cache (paged arena) before the slot recycles
+                    self.release(st.slot, insert_prefix=True)
+                    finished.append(st)
+                    # the device clamps n_emit at eos and the planner caps
+                    # drafts at the remaining allowance, so termination
+                    # can only land on the window's last emitted token —
+                    # the RNG chain is exactly where spec-off stopped
+                    assert j == n - 1, (
+                        f"request {req.request_id}: terminated at emitted "
+                        f"token {j + 1} of {n} — device/planner clamp drift"
+                    )
+                    break
+            if w.spec_len > 0:
+                # the rejected tail of the verify window feeds the next
+                # step's no-match draft fallback (stale-but-plausible
+                # verifier predictions, the lockstep engine's trick)
+                st.draft_tail = [
+                    int(next_tokens[w.slot, j])
+                    for j in range(emitted, w.spec_len + 1)
+                ]
+                if self.metrics is not None:
+                    self.metrics.on_spec(
+                        st, proposed=w.spec_len,
+                        accepted=max(emitted - 1, 0), emitted=emitted,
+                    )
         if self.paged:
             self.assert_page_invariants()
         if self.metrics is not None:

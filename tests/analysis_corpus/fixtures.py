@@ -8,6 +8,9 @@ trace-ready evidence of one statically-visible bug class:
   carry re-puts the head partition onto the slot dim
 - ``paged_pool_carry_drift`` R2: the block-paged pool carry (gather/
   scatter through a page table) whose write-back sharding drifts
+- ``spec_frontier_mask_drift`` R2: the speculative verify step's
+  multi-token frontier writes (a k+1-wide window per slot at its own
+  frontier) whose arena carry-out sharding drifts
 - ``missing_psum_grads``    R1: dp-local grads applied as if reduced
 - ``broken_ppermute_ring``  R3: a pipeline ring with a stray edge
 - ``read_after_donate``     R4: a rotating slot read after overwrite
@@ -154,6 +157,50 @@ def paged_pool_carry_drift():
 def paged_pool_carry_drift_clean():
     mesh = corpus_mesh()
     return _paged_pool_scan(mesh, False), {"mesh": mesh}, "R2"
+
+
+# ---------------------------------------------------------------- R2 quater
+def _spec_frontier_scan(mesh, drift: bool):
+    """The SPECULATIVE serving step's arena carry: each slot writes a
+    k+1-wide verify window (committed token + k drafts) at its own
+    frontier — a vmapped per-row dynamic_update_slice, the multi-token
+    form of the slot engine's frontier write — and the arena must keep
+    its head partition through the carry. The drifted form re-puts the
+    carry with the partition moved onto the slot dim: the bug a spec
+    step whose masked window write-back loses its sharding constraint
+    compiles to (the whole arena reshards over ICI every verify)."""
+    resting = NamedSharding(mesh, P(None, None, "tp"))
+    writeback = NamedSharding(
+        mesh, P("dp", None, None) if drift else P(None, None, "tp")
+    )
+
+    def step(arena, frontier):
+        arena = lax.with_sharding_constraint(arena, resting)
+
+        def body(c, _):
+            win = jnp.ones((4, 3, 16), c.dtype)  # k+1 = 3 verify rows/slot
+            c = jax.vmap(
+                lambda a, w, off: lax.dynamic_update_slice(a, w, (off, 0))
+            )(c, win, frontier)
+            c = jax.device_put(c, writeback)  # the step's carry-out
+            return c, ()
+
+        y, _ = lax.scan(body, arena, None, length=3)
+        return y
+
+    arena = jax.ShapeDtypeStruct((4, 8, 16), jnp.float32)
+    frontier = jnp.zeros((4,), jnp.int32)
+    return jax.make_jaxpr(step)(arena, frontier)
+
+
+def spec_frontier_mask_drift():
+    mesh = corpus_mesh()
+    return _spec_frontier_scan(mesh, True), {"mesh": mesh}, "R2"
+
+
+def spec_frontier_mask_drift_clean():
+    mesh = corpus_mesh()
+    return _spec_frontier_scan(mesh, False), {"mesh": mesh}, "R2"
 
 
 # --------------------------------------------------------------------- R1
@@ -501,6 +548,7 @@ HAZARDS = [
     stacked_dim0_drift,
     slot_cache_carry_drift,
     paged_pool_carry_drift,
+    spec_frontier_mask_drift,
     missing_psum_grads,
     broken_ppermute_ring,
     read_after_donate,
@@ -517,6 +565,7 @@ CLEAN_TWINS = [
     stacked_dim0_drift_clean,
     slot_cache_carry_drift_clean,
     paged_pool_carry_drift_clean,
+    spec_frontier_mask_drift_clean,
     missing_psum_grads_clean,
     broken_ppermute_ring_clean,
     read_after_donate_clean,

@@ -11,6 +11,11 @@ continuous-batching serving engine on a CPU mesh.
                                                      # arena + prefix-heavy
                                                      # trace (one shared
                                                      # system prompt)
+    python tools/bench_serve.py --spec --repetitive-prompt 3  # speculative
+                                                     # decoding over a
+                                                     # repetitive-prompt
+                                                     # trace (n-gram drafts
+                                                     # land acceptances)
 
 Arrivals land on a VIRTUAL clock (exponential inter-arrival gaps at
 ``--rate`` requests/s); each engine step advances the clock by its
@@ -72,7 +77,16 @@ def build_trace(args):
     for i in range(args.requests):
         plen = int(r.randint(args.min_prompt, args.max_prompt + 1))
         new = int(r.randint(args.min_new, args.max_new + 1))
-        user = r.randint(0, args.vocab, size=(plen,))
+        if args.repetitive_prompt > 0:
+            # repetitive-prompt replay (--spec's natural traffic): each
+            # prompt tiles a short per-request motif, so the n-gram /
+            # prompt-lookup drafts find their context and an untrained
+            # greedy model settles into a cycle the lookup then predicts
+            motif = r.randint(0, args.vocab,
+                              size=(args.repetitive_prompt,))
+            user = np.tile(motif, -(-plen // args.repetitive_prompt))[:plen]
+        else:
+            user = r.randint(0, args.vocab, size=(plen,))
         prompt = np.concatenate([system, user])
         trace.append((float(arrivals[i]), f"req-{i}", prompt, new))
     return trace
@@ -118,6 +132,23 @@ def main(argv=None) -> int:
     ap.add_argument("--system-prompt", type=int, default=0, metavar="LEN",
                     help="prepend one shared LEN-token system prompt to "
                          "every request (prefix-heavy trace)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding (serving.spec): each decode "
+                         "slot proposes n-gram drafts, the one step "
+                         "verifies them — a spec slot claims max_draft+1 "
+                         "budget rows")
+    ap.add_argument("--max-draft", type=int, default=4,
+                    help="draft tokens per decode slot per step (--spec)")
+    ap.add_argument("--ngram-n", type=int, default=3,
+                    help="n-gram context length of the draft lookup")
+    ap.add_argument("--repetitive-prompt", type=int, default=0,
+                    metavar="MOTIF",
+                    help="tile each prompt from a MOTIF-token per-request "
+                         "motif (the repetitive traffic speculative "
+                         "decoding accelerates)")
+    ap.add_argument("--check-acceptance", action="store_true",
+                    help="exit 1 unless acceptance rate > 0 and mean "
+                         "accepted tokens/step > 1 (the spec CI gate)")
     args = ap.parse_args(argv)
 
     import jax
@@ -164,6 +195,11 @@ def main(argv=None) -> int:
             "page_size": args.page_size,
             "num_pages": args.num_pages,
             "prefix_cache": not args.no_prefix_cache,
+            "spec": {
+                "enabled": args.spec,
+                "max_draft": args.max_draft,
+                "ngram_n": args.ngram_n,
+            },
         },
     )
     if args.trace:
@@ -211,6 +247,14 @@ def main(argv=None) -> int:
             f"prompt tokens), cow_copies={m['cow_copies']}, "
             f"prefill_chunks={m['prefill_chunks']}"
         )
+    if args.spec:
+        print(
+            f"spec: {m['spec_steps']} verify windows, acceptance rate "
+            f"{m['acceptance_rate']:.3f} "
+            f"({m['draft_tokens_accepted']}/{m['draft_tokens_proposed']} "
+            f"drafts), mean accepted tokens/step "
+            f"{m['mean_accepted_tokens_per_step']:.2f}"
+        )
     print(
         f"recompiles: serving step traces={srv.step_traces} "
         f"(zero-after-warmup criterion: 1), lockstep engine compiles="
@@ -226,6 +270,14 @@ def main(argv=None) -> int:
     if args.check_recompiles and srv.step_traces != 1:
         print("ERROR: the slot step recompiled after warmup")
         return 1
+    if args.check_acceptance:
+        if m["acceptance_rate"] <= 0.0:
+            print("ERROR: no draft token was ever accepted")
+            return 1
+        if m["mean_accepted_tokens_per_step"] <= 1.0:
+            print("ERROR: mean accepted tokens/step did not exceed 1 "
+                  "(speculation bought nothing)")
+            return 1
     return 0
 
 
