@@ -53,17 +53,7 @@ def tp_overlap_ab_mode() -> bool:
     matmul (tensor_parallel.overlap_comm). Like smoke mode it forces the
     CPU platform (and an 8-device host mesh so tp=2 × dp=4 exists); must
     run before any jax backend init."""
-    on = bool(os.environ.get("BENCH_TP_OVERLAP_AB"))
-    if on:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            )
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    return on
+    return _force_cpu_mesh_mode("BENCH_TP_OVERLAP_AB")
 
 
 def run_tp_overlap_ab():
@@ -79,7 +69,6 @@ def run_tp_overlap_ab():
     import deepspeed_tpu
     import deepspeed_tpu.comm as comm
     from deepspeed_tpu.models import llama
-    from deepspeed_tpu.profiling.comm_logger import CommsLogger
 
     B, S = 8, 256
     model = llama(
@@ -122,30 +111,167 @@ def run_tp_overlap_ab():
 
     dt_serial, _, _ = leg({"tp_size": 2})
     dt_overlap, stream, ring_line = leg(overlap_tp_section(2))
-    ring_bytes = (stream or {}).get("bytes_per_step", 0)
-    # wire-seconds estimate at the configured ICI bandwidth — the
-    # denominator of the overlap ratio (meaningful on-chip; on the CPU
-    # mesh it just exercises the accounting path end-to-end)
+    print(ring_line)
+    _ab_result(
+        "tp_overlap A/B (CPU-mesh validation, not a perf record; "
+        "knob default-off pending on-chip A/B)",
+        dt_serial, dt_overlap, (stream or {}).get("bytes_per_step", 0),
+    )
+
+
+def moe_a2a_ab_mode() -> bool:
+    """BENCH_MOE_A2A_AB=1 → CPU-mesh A/B of the decomposed MoE all-to-all
+    (moe.overlap_a2a). Forces the CPU platform + an 8-device host mesh
+    (dp=2 × ep=4); must run before any jax backend init."""
+    return _force_cpu_mesh_mode("BENCH_MOE_A2A_AB")
+
+
+def z3_prefetch_ab_mode() -> bool:
+    """BENCH_Z3_PREFETCH_AB=1 → CPU-mesh A/B of the ZeRO-3 one-layer-ahead
+    parameter prefetch (zero_optimization.stage3_layer_prefetch)."""
+    return _force_cpu_mesh_mode("BENCH_Z3_PREFETCH_AB")
+
+
+def _force_cpu_mesh_mode(env: str) -> bool:
+    on = bool(os.environ.get(env))
+    if on:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return on
+
+
+def _ab_result(metric, dt_serial, dt_overlap, stream_bytes, extra=None):
+    """The shared serial-vs-overlapped A/B JSON line: step times, the
+    analytic stream MiB/step, the wire-seconds estimate at the configured
+    ICI bandwidth and the overlap ratio (meaningful on-chip; on the CPU
+    mesh it exercises the accounting path end-to-end — same protocol as
+    run_tp_overlap_ab, so no perf record is banked)."""
+    from deepspeed_tpu.profiling.comm_logger import CommsLogger
+
     bw = float(os.environ.get("BENCH_ICI_BW_GBS", 45)) * 1e9
-    wire_s = ring_bytes / bw if bw > 0 else 0.0
+    wire_s = stream_bytes / bw if bw > 0 else 0.0
     result = {
-        "metric": (
-            "tp_overlap A/B (CPU-mesh validation, not a perf record; "
-            "knob default-off pending on-chip A/B)"
-        ),
+        "metric": metric,
         "value": round(dt_overlap, 4),
         "unit": "s/step (overlapped leg)",
         "vs_baseline": 1.0,
         "step_s_serial": round(dt_serial, 4),
         "step_s_overlap": round(dt_overlap, 4),
-        "ring_mib_per_step": round(ring_bytes / 2**20, 3),
+        "ring_mib_per_step": round(stream_bytes / 2**20, 3),
         "est_ring_wire_s": round(wire_s, 6),
         "overlap_ratio": round(
             CommsLogger.overlap_ratio(dt_serial, dt_overlap, wire_s), 4
         ),
     }
-    print(ring_line)
+    result.update(extra or {})
     print(json.dumps(result))
+
+
+def _timed_leg(engine, data, n: int = 5):
+    """Compile + time n steps; returns per-step seconds with the ring
+    accounting reset so the logged window covers the timed steps only."""
+    import jax
+
+    engine.train_batch(batch=data)  # compile
+    if engine.comm_logger is not None:
+        engine.comm_logger.ring_steps = 0
+        engine.comm_logger.ring_bytes = 0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        engine.train_batch(batch=data)
+    jax.block_until_ready(engine.state.params)
+    return (time.perf_counter() - t0) / n
+
+
+def run_moe_a2a_ab():
+    """Serial (GSPMD-inserted exchange) vs overlapped (decomposed ring)
+    MoE step on the CPU mesh — an end-to-end *validation* A/B printing
+    ONE JSON line with step times, the analytic a2a MiB/step and the
+    overlap ratio; the knob stays default-off and the on-chip recipe is
+    docs/overlap.md."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.models import mixtral
+
+    B, S = 8, 128
+    model = mixtral(
+        "mixtral-tiny", vocab_size=512, max_seq_len=S, num_experts=4,
+    )
+    data = {
+        "input_ids": np.random.RandomState(0).randint(0, 512, size=(B, S))
+    }
+
+    def leg(overlap):
+        comm.destroy_process_group()
+        cfg = make_ds_config(B, {"stage": 0}, "none", B // 2, {})
+        cfg["moe"] = moe_overlap_section(ep_size=4)
+        cfg["moe"]["overlap_a2a"]["enabled"] = overlap
+        cfg["comms_logger"] = {"enabled": True}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        dt = _timed_leg(engine, data)
+        stream = engine.analytic_streams().get("moe_a2a") or {}
+        ring_line = (
+            engine.comm_logger.ring_summary(duration_s=5 * dt)
+            if engine.comm_logger else ""
+        )
+        engine.destroy()
+        return dt, stream, ring_line
+
+    dt_serial, _, _ = leg(False)
+    dt_overlap, stream, ring_line = leg(True)
+    print(ring_line)
+    _ab_result(
+        "moe_a2a A/B (CPU-mesh validation, not a perf record; knob "
+        "default-off pending on-chip A/B)",
+        dt_serial, dt_overlap, stream.get("bytes_per_step", 0),
+        extra={"capacity": stream.get("capacity")},
+    )
+
+
+def run_z3_prefetch_ab():
+    """Plain stage 3 (all-gather-on-use) vs one-layer-ahead prefetch on
+    the CPU mesh — same validation protocol as run_moe_a2a_ab."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.models import llama
+
+    B, S = 8, 128
+    model = llama(
+        "llama-tiny", vocab_size=512, max_seq_len=S, hidden_size=128,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+        intermediate_size=512,
+    )
+    data = {
+        "input_ids": np.random.RandomState(0).randint(0, 512, size=(B, S))
+    }
+
+    def leg(prefetch):
+        comm.destroy_process_group()
+        zero = {"stage": 3, "stage3_param_persistence_threshold": 1000,
+                "stage3_layer_prefetch": prefetch}
+        cfg = make_ds_config(B, zero, "none", 1, {})
+        cfg["comms_logger"] = {"enabled": True}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        dt = _timed_leg(engine, data)
+        stream = engine.analytic_streams().get("zero3_prefetch") or {}
+        engine.destroy()
+        return dt, stream
+
+    dt_serial, _ = leg(False)
+    dt_overlap, stream = leg(True)
+    _ab_result(
+        "zero3_prefetch A/B (CPU-mesh validation, not a perf record; "
+        "knob default-off pending on-chip A/B)",
+        dt_serial, dt_overlap, stream.get("bytes_per_step", 0),
+        extra={"slots": stream.get("slots"),
+               "passes": stream.get("passes")},
+    )
 
 
 def enable_compile_cache():
@@ -271,12 +397,33 @@ def overlap_tp_section(tp_size: int = 2, *, bidirectional: bool = True,
     }
 
 
+def moe_overlap_section(ep_size: int = 2, *, chunks: int = 2,
+                        bidirectional: bool = True):
+    """The moe section the a2a-overlap A/B and shardlint legs share
+    (decomposed MoE all-to-all; parallel/a2a_overlap.py)."""
+    return {
+        "enabled": True,
+        "ep_size": ep_size,
+        "num_experts": 4,
+        "overlap_a2a": {
+            "enabled": True,
+            "chunks": chunks,
+            "bidirectional": bidirectional,
+        },
+    }
+
+
 def lint_targets(dp: int):
     """(name, model, ds_config) for the bench legs shardlint gates (the
     acceptance surface of ISSUE 2): the 410m leg and the 1.5B ZeRO-3 +
-    pinned-host-offload leg, serial and double-buffered. Models are
-    config shells only — shardlint traces them abstractly, nothing is
-    materialized, so the 1.4B leg lints in seconds on CPU."""
+    pinned-host-offload leg, serial and double-buffered, plus the ISSUE-10
+    overlap legs (decomposed MoE a2a on an ep mesh; stage-3 one-layer
+    prefetch) whose declared streams rule R8 must statically confirm fit
+    the compute window. Models are config shells only — shardlint traces
+    them abstractly, nothing is materialized, so the 1.4B leg lints in
+    seconds on CPU."""
+    from deepspeed_tpu.models import mixtral
+
     model_410m, B, _S = bench_model(smoke=False, tag="410m")
     model_1b, _B1, _S1 = bench_model(smoke=False, tag="1b")
     B = -(-B // dp) * dp  # same dp-divisibility round-up as main()
@@ -284,12 +431,30 @@ def lint_targets(dp: int):
     tiles = {"flash_block_q": 512, "flash_block_k": 1024}
     offload = {"stage": 3, "offload_optimizer": {"device": "cpu"},
                "offload_param": {"device": "cpu"}}
+    moe_model = mixtral(
+        "mixtral-tiny", vocab_size=2048, max_seq_len=256, num_layers=4,
+        num_experts=4,
+    )
+    # the moe leg shapes its own batch: the lint mesh splits the 8
+    # devices dp=4 × ep=2, so 16 = micro 2 × dp 4 × accum 2
+    moe_cfg = make_ds_config(16, {"stage": 1}, "none", 2, {})
+    moe_cfg["moe"] = moe_overlap_section()
+    z3_cfg = make_ds_config(
+        B,
+        {"stage": 3, "stage3_param_persistence_threshold": 10**5,
+         "stage3_layer_prefetch": True},
+        "none", micro, {},
+    )
     return [
         ("bench-410m", model_410m,
          make_ds_config(B, {"stage": 0}, "none", micro, {})),
         ("bench-410m-tp-overlap", model_410m,
          make_ds_config(B, {"stage": 0}, "none", micro, {},
                         tp=overlap_tp_section())),
+        ("bench-moe-a2a", moe_model, moe_cfg),
+        ("bench-410m-z3-prefetch", model_410m, z3_cfg),
+        # the 1.5B pair stays LAST: the lint speed budget test times the
+        # biggest target via lint_targets()[-1]
         ("bench-1b-offload", model_1b,
          make_ds_config(B, dict(offload), "dots_flash", 1, tiles)),
         ("bench-1b-offload-db", model_1b,
@@ -478,6 +643,10 @@ def main():
 
     if tp_overlap_ab_mode():
         return run_tp_overlap_ab()
+    if moe_a2a_ab_mode():
+        return run_moe_a2a_ab()
+    if z3_prefetch_ab_mode():
+        return run_z3_prefetch_ab()
     smoke = smoke_mode()
     enable_compile_cache()
     import deepspeed_tpu

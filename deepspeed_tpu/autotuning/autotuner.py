@@ -382,6 +382,10 @@ class Autotuner:
                 # replaces sections wholesale, so a bare flag would wipe
                 # tp_size on merge
                 rec["tensor_parallel"] = cfg["tensor_parallel"]
+            if pc.cand.moe_a2a is not None:
+                rec["moe"] = cfg["moe"]  # same wholesale-section rule
+            if pc.cand.z3_prefetch is not None:
+                rec["zero_optimization"] = cfg["zero_optimization"]
             self.results.append(rec)
             log_dist(f"autotune: planner top-k {pc.cand.label()}: "
                      f"{tput:.0f} tok/s (predicted "

@@ -20,6 +20,10 @@ Candidate axes:
   roofline's ``max()`` neutralizes ring bytes that hide under compute,
   so an overlapped leg never loses rank for declaring its wire traffic
   while a serial leg's GSPMD collectives stay invisible;
+- moe-a2a on/off when the config runs expert parallelism, and stage-3
+  layer-prefetch on/off on stage-3 rungs (ISSUE 10): both priced through
+  the same R6/R8 static gate BEFORE any compile — R8 rejects a rung
+  whose declared-overlapped stream cannot hide in the compute window;
 - serving ``token_budget`` for serving-enabled configs (the slot step
   is traced through ``lint_serving_config`` instead of a train step);
 - mesh shape (dp×tp factorizations) for capacity dryruns — CLI-only,
@@ -68,6 +72,8 @@ class Candidate:
     micro: int = 1
     flash_blocks: Tuple[int, ...] = (0, 0)
     tp_overlap: Optional[bool] = None
+    moe_a2a: Optional[bool] = None       # decomposed MoE a2a on/off
+    z3_prefetch: Optional[bool] = None   # stage-3 layer prefetch on/off
     token_budget: Optional[int] = None
     mesh: Optional[Tuple[int, int]] = None  # (dp, tp)
 
@@ -84,7 +90,8 @@ class Candidate:
         """Everything but micro — the memoization group whose plans
         scale batch-linearly."""
         return (self.zero, self.remat, self.flash_blocks, self.tp_overlap,
-                self.token_budget, self.mesh)
+                self.moe_a2a, self.z3_prefetch, self.token_budget,
+                self.mesh)
 
     def label(self) -> str:
         z = self.zero_dict
@@ -97,6 +104,10 @@ class Candidate:
         parts = [zs, self.remat, f"mb{self.micro}"]
         if self.tp_overlap is not None:
             parts.append("tpov" if self.tp_overlap else "tpser")
+        if self.moe_a2a is not None:
+            parts.append("a2aov" if self.moe_a2a else "a2aser")
+        if self.z3_prefetch is not None:
+            parts.append("z3pf" if self.z3_prefetch else "z3ser")
         if self.token_budget is not None:
             parts = [f"serve-tb{self.token_budget}"]
         if self.mesh is not None:
@@ -282,22 +293,41 @@ class PlannerSearch:
         overlap_axis: List[Optional[bool]] = (
             [False, True] if tp > 1 else [None]
         )
+        # decomposed MoE a2a: an axis only where an expert exchange exists
+        moe_on = bool(getattr(ds.moe, "enabled", False)) and int(
+            getattr(ds.moe, "ep_size", 1)
+        ) > 1
+        a2a_axis: List[Optional[bool]] = (
+            [False, True] if moe_on else [None]
+        )
         tiles = FLASH_BLOCKS if self.include_tiles else ((0, 0),)
         meshes: List[Optional[Tuple[int, int]]] = (
             list(self.mesh_shapes) if self.mesh_shapes else [None]
         )
+        base_stage = int(ds.zero_config.stage)
         out = []
         for mesh in meshes:
             for zero in self._zero_axis():
+                # stage-3 layer prefetch: an axis only on stage-3 rungs
+                # (the knob is a no-op elsewhere — enumerating it would
+                # double-count identical plans)
+                stage = (json.loads(zero).get("stage", 0)
+                         if zero is not None else base_stage)
+                z3_axis: List[Optional[bool]] = (
+                    [False, True] if int(stage) == 3 else [None]
+                )
                 for pol in REMAT_POLICIES:
                     for mb in mbs:
                         for ov in overlap_axis:
-                            for blocks in tiles:
-                                out.append(Candidate(
-                                    zero=zero, remat=pol, micro=mb,
-                                    flash_blocks=tuple(blocks),
-                                    tp_overlap=ov, mesh=mesh,
-                                ))
+                            for a2a in a2a_axis:
+                                for z3 in z3_axis:
+                                    for blocks in tiles:
+                                        out.append(Candidate(
+                                            zero=zero, remat=pol, micro=mb,
+                                            flash_blocks=tuple(blocks),
+                                            tp_overlap=ov, moe_a2a=a2a,
+                                            z3_prefetch=z3, mesh=mesh,
+                                        ))
         return out
 
     # ----------------------------------------------------------------- plan
@@ -316,6 +346,16 @@ class PlannerSearch:
             oc["enabled"] = bool(cand.tp_overlap)
             tp["overlap_comm"] = oc
             cfg["tensor_parallel"] = tp
+        if cand.moe_a2a is not None:
+            moe = dict(cfg.get("moe") or {})
+            oa = dict(moe.get("overlap_a2a") or {})
+            oa["enabled"] = bool(cand.moe_a2a)
+            moe["overlap_a2a"] = oa
+            cfg["moe"] = moe
+        if cand.z3_prefetch is not None:
+            zo = dict(cfg.get("zero_optimization") or {})
+            zo["stage3_layer_prefetch"] = bool(cand.z3_prefetch)
+            cfg["zero_optimization"] = zo
         if cand.token_budget is not None:
             sv = dict(cfg.get("serving") or {})
             sv["token_budget"] = int(cand.token_budget)

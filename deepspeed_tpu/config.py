@@ -125,6 +125,16 @@ class ZeroConfig:
     # Costs one extra layer slice of HBM; off until on-chip parity + A/B
     # land. "sub_group_prefetch" is accepted as an alias.
     offload_double_buffer: bool = False
+    # one-layer-ahead stage-3 parameter all-gather prefetch: the layer
+    # scan carries a rotating two-slot gathered-params buffer (the PR-1
+    # offload_double_buffer pattern applied to the fwd/bwd scan), so
+    # layer i+1's all-gather is issued under layer i's math instead of
+    # stalling layer i+1's compute on its own fetch
+    # (runtime/zero/prefetch.py). Persistence-threshold (replicated)
+    # params are excluded automatically — their "gather" is a no-op.
+    # Off by default pending an on-chip A/B; "zero3_prefetch" is
+    # accepted as an alias. Ignored (with a log line) when stage != 3.
+    stage3_layer_prefetch: bool = False
     offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = field(default_factory=OffloadConfig)
     stage3_max_live_parameters: int = 10**9
@@ -185,6 +195,36 @@ class PipelineConfig:
 
 
 @dataclass
+class MoEOverlapA2AConfig:
+    """"moe.overlap_a2a" — decomposed MoE all-to-all
+    (parallel/a2a_overlap.py): the GSPMD dispatch/combine exchanges at the
+    expert boundary decompose into chunked ppermute hops on the ep-axis
+    ring whose wire time hides under the per-chunk expert FFN matmuls —
+    each expert shard starts computing as soon as a capacity chunk lands
+    instead of waiting for the whole [E, C, D] exchange. Default OFF until
+    an on-chip A/B lands (the same protocol as
+    tensor_parallel.overlap_comm / zero_optimization.offload_double_buffer);
+    numerics of the rings are oracle-verified BITWISE against the module's
+    pure-XLA reference path on CPU meshes for both dispatch modes
+    (tests/test_moe_a2a_overlap.py)."""
+
+    enabled: bool = False
+    # capacity chunks per exchange (the ring/FFN pipelining granularity:
+    # chunk k+1's hops fly while chunk k's expert matmuls run); uneven
+    # splits allowed, never changes numerics for top_k <= 2
+    chunks: int = 1
+    # halves of each capacity chunk ride both ring directions at once
+    # (full-duplex ICI halves per-hop wire time, same hop count)
+    bidirectional: bool = False
+
+    def validate(self) -> None:
+        if int(self.chunks) < 1:
+            raise DeepSpeedConfigError(
+                f"moe.overlap_a2a.chunks must be >= 1, got {self.chunks}"
+            )
+
+
+@dataclass
 class MoEConfig:
     enabled: bool = False
     ep_size: int = 1
@@ -197,6 +237,18 @@ class MoEConfig:
     z_loss_coef: float = 1e-3
     drop_tokens: bool = True
     use_residual: bool = False
+    overlap_a2a: MoEOverlapA2AConfig = field(
+        default_factory=MoEOverlapA2AConfig
+    )
+
+    def __post_init__(self):
+        # _parse_dc is shallow: the nested section arrives as a dict (or a
+        # bare bool, the overlap_comm spelling) — normalize here
+        if isinstance(self.overlap_a2a, bool):
+            self.overlap_a2a = MoEOverlapA2AConfig(enabled=self.overlap_a2a)
+        elif isinstance(self.overlap_a2a, dict):
+            self.overlap_a2a = _parse_dc(MoEOverlapA2AConfig,
+                                         self.overlap_a2a)
 
 
 @dataclass
@@ -641,6 +693,11 @@ class DeepSpeedConfig:
         if "sub_group_prefetch" in zo:  # alias (sub_group_size kin)
             zo.setdefault("offload_double_buffer", zo["sub_group_prefetch"])
         zo["offload_double_buffer"] = bool(zo.get("offload_double_buffer", False))
+        if "zero3_prefetch" in zo:  # alias (the ROADMAP/ISSUE spelling)
+            zo.setdefault("stage3_layer_prefetch", zo.pop("zero3_prefetch"))
+        zo["stage3_layer_prefetch"] = bool(
+            zo.get("stage3_layer_prefetch", False)
+        )
         zo["offload_optimizer"] = _parse_dc(OffloadConfig, zo.get("offload_optimizer"))
         zo["offload_param"] = _parse_dc(OffloadConfig, zo.get("offload_param"))
         self.zero_config = _parse_dc(ZeroConfig, zo)
@@ -766,6 +823,7 @@ class DeepSpeedConfig:
                 "pp stage boundaries)"
             )
         self.tensor_parallel.overlap_comm.validate()
+        self.moe.overlap_a2a.validate()
         self.serving.validate()
         if (
             self.tensor_parallel.overlap_comm.enabled
@@ -776,6 +834,13 @@ class DeepSpeedConfig:
                 "parallelism (the decomposed matmul is a full-manual "
                 "shard_map and cannot nest inside the pipeline's manual "
                 "schedule); the runtime also falls back per call site"
+            )
+        if self.moe.overlap_a2a.enabled and self.pipeline.stages > 1:
+            raise DeepSpeedConfigError(
+                "moe.overlap_a2a is not supported with pipeline parallelism "
+                "(the decomposed all-to-all is a full-manual shard_map and "
+                "cannot nest inside the pipeline's manual schedule); the "
+                "runtime also falls back per call site"
             )
         if self.data_efficiency.random_ltd.enabled and self.pipeline.stages > 1:
             raise DeepSpeedConfigError(

@@ -441,6 +441,22 @@ def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
         parts = (jax.tree.map(sl, layers), keys[lo:hi])
         return parts + ((pld_keep[lo:hi],) if use_pld else ())
 
+    # ZeRO-3 one-layer-ahead parameter prefetch (runtime/zero/prefetch.py):
+    # with the scope active, the scan carries a rotating gathered-params
+    # slot so layer i+1's all-gather issues under layer i's math instead
+    # of stalling every layer on its own fetch
+    from ..runtime.zero.prefetch import current_prefetch
+
+    z3_puts = current_prefetch()
+
+    def seg_scan(bodyfn, carry, lo, hi):
+        xs = seg_xs(lo, hi)
+        if z3_puts is not None:
+            from ..runtime.zero.prefetch import scan_layers
+
+            return scan_layers(bodyfn, carry, xs[0], xs[1:], z3_puts)
+        return lax.scan(bodyfn, carry, xs)
+
     # NOTE: unrolling this scan (lax.scan(..., unroll=2)) was measured
     # 15% SLOWER on-chip at the record config (32,020 vs 37,682 tok/s) —
     # the duplicated remat/checkpoint bodies cost more than the saved
@@ -454,14 +470,14 @@ def apply_layer_stack(cfg: TransformerConfig, layers: Params, x: jax.Array,
                 f"random_ltd layer range {ltd_layers} outside [0, {num_layers})"
             )
         if lo > 0:
-            carry, _ = lax.scan(full_body, carry, seg_xs(0, lo))
-        carry, _ = lax.scan(ltd_body, carry, seg_xs(lo, hi))
+            carry, _ = seg_scan(full_body, carry, 0, lo)
+        carry, _ = seg_scan(ltd_body, carry, lo, hi)
         if hi < num_layers:
-            carry, _ = lax.scan(full_body, carry, seg_xs(hi, num_layers))
+            carry, _ = seg_scan(full_body, carry, hi, num_layers)
         x, aux = carry
         return x, aux
 
-    (x, aux), _ = lax.scan(full_body, carry, seg_xs(0, num_layers))
+    (x, aux), _ = seg_scan(full_body, carry, 0, num_layers)
     return x, aux
 
 

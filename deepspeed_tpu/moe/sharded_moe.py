@@ -28,7 +28,22 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..models.sharding import constrain
+from ..models.sharding import constrain, current_topology
+
+
+def _a2a_overlap_active(B: int, S: int, E: int, F: int):
+    """(overlap_cfg, topology) when the decomposed-a2a scope is active AND
+    the shapes divide the mesh (moe.overlap_a2a — parallel/a2a_overlap.py);
+    (None, None) otherwise, and the serial GSPMD path runs."""
+    from ..parallel.a2a_overlap import current_a2a, moe_a2a_applicable
+
+    cfg = current_a2a()
+    if cfg is None:
+        return None, None
+    topo = current_topology()
+    if topo is None or not moe_a2a_applicable(topo, B=B, S=S, E=E, F=F):
+        return None, None
+    return cfg, topo
 
 
 def _gating_rounds(logits, top_k, capacity, rng, train, noise_std):
@@ -172,6 +187,11 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
             f"moe_dispatch {dispatch_mode!r} (must be 'einsum' or 'gather')"
         )
     use_gather = dispatch_mode == "gather"
+    # decomposed-a2a overlap (moe.overlap_a2a): when the scope is active
+    # and shapes divide, the dispatch/combine exchanges run as chunked
+    # ppermute rings whose hops hide under the per-chunk expert FFN
+    # (parallel/a2a_overlap.py); the serial GSPMD path below otherwise
+    ov, otopo = _a2a_overlap_active(B, S, E, p["wi"].shape[-1])
     if use_gather:
         # permutation as gathers, not one-hot dots: O(N·D·K) moved bytes
         # instead of O(N·E·C·D) MXU flops each way
@@ -179,37 +199,61 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
             top_k_gating_indices(router_logits, cfg.moe_top_k, capacity, rng,
                                  train)
         )
-        expert_in = (
-            jnp.take(tokens, tok_of_slot.reshape(-1), axis=0)
-            .reshape(E, capacity, D)
-            * slot_valid[..., None].astype(x.dtype)
-        )
     else:
         dispatch, combine, metrics = top_k_gating(
             router_logits, cfg.moe_top_k, capacity, rng, train
         )
-        # dispatch: [N,E,C] x [N,D] -> [E,C,D], sharded over ep
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
-    expert_in = constrain(expert_in, "ep", None, None)
+    if ov is not None:
+        from ..parallel.a2a_overlap import moe_a2a_ffn
 
-    h = jnp.einsum("ecd,edf->ecf", expert_in, p["wi"])
-    if cfg.activation == "swiglu":
-        g = jnp.einsum("ecd,edf->ecf", expert_in, p["wg"])
-        h = jax.nn.silu(g) * h
+        K = cfg.moe_top_k
+        gating = (
+            ("gather", tok_of_slot, slot_valid,
+             slot_of_tok.reshape(B, S, K), w_of_tok.reshape(B, S, K))
+            if use_gather
+            else ("einsum",
+                  dispatch.astype(x.dtype).reshape(B, S, E, capacity),
+                  combine.astype(x.dtype).reshape(B, S, E, capacity))
+        )
+        out = moe_a2a_ffn(
+            x, gating,
+            (p["wi"], p.get("wg") if cfg.activation == "swiglu" else None,
+             p["wo"]),
+            otopo, chunks=int(ov.chunks),
+            bidirectional=bool(ov.bidirectional),
+        )
     else:
-        h = jax.nn.gelu(h)
-    h = constrain(h, "ep", None, "tp")
-    expert_out = jnp.einsum("ecf,efd->ecd", h, p["wo"])
-    expert_out = constrain(expert_out, "ep", None, None)
+        if use_gather:
+            expert_in = (
+                jnp.take(tokens, tok_of_slot.reshape(-1), axis=0)
+                .reshape(E, capacity, D)
+                * slot_valid[..., None].astype(x.dtype)
+            )
+        else:
+            # dispatch: [N,E,C] x [N,D] -> [E,C,D], sharded over ep
+            expert_in = jnp.einsum(
+                "nec,nd->ecd", dispatch.astype(x.dtype), tokens
+            )
+        expert_in = constrain(expert_in, "ep", None, None)
 
-    if use_gather:
-        picked = jnp.take(
-            expert_out.reshape(E * capacity, D), slot_of_tok.reshape(-1),
-            axis=0,
-        ).reshape(N, cfg.moe_top_k, D)
-        out = jnp.sum(picked * w_of_tok[..., None].astype(x.dtype), axis=1)
-    else:
-        out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+        h = jnp.einsum("ecd,edf->ecf", expert_in, p["wi"])
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("ecd,edf->ecf", expert_in, p["wg"])
+            h = jax.nn.silu(g) * h
+        else:
+            h = jax.nn.gelu(h)
+        h = constrain(h, "ep", None, "tp")
+        expert_out = jnp.einsum("ecf,efd->ecd", h, p["wo"])
+        expert_out = constrain(expert_out, "ep", None, None)
+
+        if use_gather:
+            picked = jnp.take(
+                expert_out.reshape(E * capacity, D), slot_of_tok.reshape(-1),
+                axis=0,
+            ).reshape(N, cfg.moe_top_k, D)
+            out = jnp.sum(picked * w_of_tok[..., None].astype(x.dtype), axis=1)
+        else:
+            out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
     aux = metrics["aux_loss"] + (cfg.moe_z_loss_coef / max(cfg.moe_aux_loss_coef, 1e-9)) * metrics["z_loss"]
     out = out.reshape(B, S, D)
 

@@ -4,6 +4,10 @@ from .sequence import (  # noqa: F401
     sp_attention,
     ulysses_attention,
 )
+from .a2a_overlap import (  # noqa: F401
+    a2a_scope,
+    moe_a2a_ffn,
+)
 from .tensor_overlap import (  # noqa: F401
     allgather_matmul,
     matmul_reducescatter,

@@ -66,10 +66,14 @@ class CommsLogger:
         self.offload_bytes_out = 0
         self.offload_slots = 0
         self.offload_slot_bytes = 0
-        # decomposed-TP ring accounting (tensor_parallel.overlap_comm):
-        # scanned layers trace their ring hops once, so the hook bus
-        # under-counts them — the engine reports the analytic per-step
-        # wire bytes here (parallel/tensor_overlap.ring_wire_bytes_per_step)
+        # decomposed-ring accounting (tensor_parallel.overlap_comm rings
+        # AND the moe.overlap_a2a exchange hops AND the stage3 prefetch
+        # gathers — every "ici"-kind analytic stream): scanned layers
+        # trace their ring hops once, so the hook bus under-counts them —
+        # the engine reports the analytic per-step wire bytes here
+        # (tensor_overlap.ring_wire_bytes_per_step,
+        # a2a_overlap.moe_a2a_bytes_per_step,
+        # prefetch.prefetch_wire_bytes_per_step)
         self.ring_steps = 0
         self.ring_bytes = 0
         # serving KV-arena accounting (serving/engine.analytic_streams):
@@ -128,7 +132,9 @@ class CommsLogger:
 
     # ------------------------------------------------- TP overlap ring stats
     def record_ring(self, nbytes_per_step: int, steps: int = 1) -> None:
-        """Account ``steps`` steps of decomposed-TP ring traffic:
+        """Account ``steps`` steps of decomposed-ring traffic (the ONE
+        intake for every "ici"-kind analytic stream: TP projection rings,
+        MoE a2a chunk hops, stage-3 prefetch gathers):
         ``nbytes_per_step`` is the per-device wire total across all rings
         of one optimizer step (forward + transposed backward hops)."""
         self.ring_steps += steps
@@ -197,7 +203,7 @@ class CommsLogger:
         per_step = self.ring_bytes / self.ring_steps
         gbps = self.ring_bytes * 8 / dur / 1e9 if dur > 0 else 0.0
         return (
-            f"tp-overlap rings: {self.ring_steps} steps, "
+            f"decomposed rings (tp/a2a/prefetch): {self.ring_steps} steps, "
             f"{per_step / 2**20:.2f} MiB/step wire (fwd+bwd hops), "
             f"{gbps:.2f} Gbps over window"
         )

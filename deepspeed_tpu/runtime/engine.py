@@ -320,6 +320,24 @@ class TpuEngine:
                     "nothing)"
                 )
                 self.tp_overlap = None
+        # ---- decomposed MoE all-to-all (moe.overlap_a2a:
+        # parallel/a2a_overlap.py). Same trace-time-scope protocol; the
+        # knob defaults off pending an on-chip A/B. ----------------------
+        mo = config.moe.overlap_a2a
+        _model_is_moe = bool(
+            getattr(getattr(model, "config", None), "is_moe", False)
+        )
+        self.moe_a2a = (
+            mo if (mo.enabled and topology.ep_size > 1 and _model_is_moe)
+            else None
+        )
+        if mo.enabled and self.moe_a2a is None:
+            log_dist(
+                "moe.overlap_a2a: "
+                + ("ep_size == 1 on this topology"
+                   if topology.ep_size <= 1 else "model is not MoE")
+                + " — no expert exchange to decompose, knob ignored"
+            )
         self.pld = None
         if config.progressive_layer_drop.enabled:
             from .progressive_layer_drop import ProgressiveLayerDrop
@@ -481,6 +499,33 @@ class TpuEngine:
         self.param_specs, self.grad_specs, self.opt_leaf_specs = zero_specs(
             params_shape, tp_specs, topology, config.zero_config
         )
+        # ---- ZeRO-3 one-layer-ahead parameter prefetch
+        # (zero_optimization.stage3_layer_prefetch: runtime/zero/prefetch.py).
+        # The puts tree is one layer slice's gathered (tp-only) shardings;
+        # persistence-threshold leaves come back as identity puts. --------
+        self._z3_prefetch_puts = None
+        self._z3_prefetch_shapes = None
+        if config.zero_config.stage3_layer_prefetch:
+            if config.zero_config.stage != 3:
+                log_dist(
+                    "zero_optimization.stage3_layer_prefetch: stage "
+                    f"{config.zero_config.stage} has no parameter gathers "
+                    "to prefetch, knob ignored"
+                )
+            else:
+                from .zero.prefetch import build_layer_puts
+
+                self._z3_prefetch_puts = build_layer_puts(
+                    params_shape, tp_specs, self.param_specs, topology
+                )
+                if self._z3_prefetch_puts is None:
+                    log_dist(
+                        "stage3_layer_prefetch: no data-sharded stacked "
+                        "'layers' leaf on this mesh (everything persistent "
+                        "or replicated) — nothing to prefetch, knob ignored"
+                    )
+                else:
+                    self._z3_prefetch_shapes = (params_shape, tp_specs)
         self._qgather = None
         zc = config.zero_config
         if zc.zero_quantized_weights or zc.zero_quantized_gradients:
@@ -689,12 +734,24 @@ class TpuEngine:
         )
         self._opt_treedef = jax.tree_util.tree_structure(opt_state)
         loss_scale = init_loss_scale(config.fp16, self.fp16_enabled)
-        self.state = TrainState(
-            params, opt_state, loss_scale, jnp.zeros((), jnp.int32)
-        )
+        step0 = jnp.zeros((), jnp.int32)
+        if not self.abstract:
+            # commit the scalar state to its replicated resting sharding
+            # NOW: the step's out_shardings put the new scale/step there,
+            # so uncommitted host scalars here would make the SECOND
+            # train_batch retrace the whole step program (fresh vs
+            # donated-state shardings) — one wasted full compile per
+            # engine, and the dryrun/serving "one steady trace" gates
+            # would always read 2
+            rep = NamedSharding(topology.mesh, P())
+            loss_scale, step0 = jax.device_put((loss_scale, step0), rep)
+        self.state = TrainState(params, opt_state, loss_scale, step0)
         self.offload_stream = self._compute_offload_stream()
         self._tp_overlap_streams = {}
         self.tp_overlap_stream = self._compute_tp_overlap_stream()
+        self._moe_a2a_streams = {}
+        self.moe_a2a_stream = self._compute_moe_a2a_stream()
+        self.z3_prefetch_stream = self._compute_z3_prefetch_stream()
         if self._nvme_swapper is not None and not self.abstract:
             # optimizer state lives on disk between steps (reference:
             # partitioned_optimizer_swapper); swapped in around each update
@@ -836,6 +893,35 @@ class TpuEngine:
                     "per_device_bytes_per_step": ring["bytes_per_step"],
                     "overlapped": True,
                 }
+        # MoE dispatch/combine traffic is declared whether or not the
+        # overlap knob is on (ISSUE-10 fix: the serial GSPMD path moves
+        # the same logical bytes, R8/shardplan must see them either way);
+        # overlapped only when the decomposed rings actually ENGAGE —
+        # the knob being on with undividable shapes falls back to the
+        # serial path at trace time (moe_a2a_applicable), and claiming
+        # overlap for it would let R8 hide wire that runs serialized
+        # (same honesty rule as ring_wire_bytes_per_step's predicates)
+        a2a = self._moe_a2a_stream_for(seq)
+        if a2a:
+            streams["moe_a2a"] = {
+                **a2a,
+                "kind": "ici",
+                # moe_a2a_bytes_per_step is already per device
+                "bytes_per_step": a2a["bytes_per_step"],
+                "per_device_bytes_per_step": a2a["bytes_per_step"],
+                "overlapped": bool(
+                    self.moe_a2a is not None and a2a.get("ring_engages")
+                ),
+            }
+        z3 = self.z3_prefetch_stream
+        if z3:
+            streams["zero3_prefetch"] = {
+                **z3,
+                "kind": "ici",
+                "bytes_per_step": z3["bytes_per_step"],
+                "per_device_bytes_per_step": z3["bytes_per_step"],
+                "overlapped": True,
+            }
         return streams
 
     def _record_offload_stream(self, steps: int = 1, batch=None):
@@ -888,6 +974,72 @@ class TpuEngine:
             else getattr(model_cfg, "max_seq_len", 0),
             itemsize=jnp.dtype(self.compute_dtype).itemsize,
             accum_steps=self.config.gradient_accumulation_steps,
+        )
+
+    def _moe_a2a_stream_for(self, seq):
+        """The analytic MoE exchange stream at one sequence length
+        (cached, the _tp_overlap_stream_for discipline)."""
+        if seq is None:
+            return self.moe_a2a_stream
+        if seq not in self._moe_a2a_streams:
+            self._moe_a2a_streams[seq] = self._compute_moe_a2a_stream(
+                seq=seq
+            )
+        return self._moe_a2a_streams[seq]
+
+    def _compute_moe_a2a_stream(self, seq=None):
+        """Static per-step MoE dispatch/combine exchange bytes (None for
+        non-MoE models or ep == 1). Declared for BOTH the serial and the
+        decomposed path — capacity scales with the batch, so recording
+        passes the actual sequence length like the TP ring stream."""
+        model_cfg = getattr(self.model, "config", None)
+        if model_cfg is None or self.topology.ep_size <= 1:
+            return None
+        from ..parallel.a2a_overlap import (
+            moe_a2a_applicable,
+            moe_a2a_bytes_per_step,
+        )
+
+        batch = (self.config.train_micro_batch_size_per_gpu
+                 * self.topology.data_shard_size)
+        seq = seq if seq is not None else getattr(
+            model_cfg, "max_seq_len", 0
+        )
+        stream = moe_a2a_bytes_per_step(
+            model_cfg,
+            self.topology,
+            batch=batch,
+            seq=seq,
+            itemsize=jnp.dtype(self.compute_dtype).itemsize,
+            accum_steps=self.config.gradient_accumulation_steps,
+        )
+        if stream is not None:
+            # whether the decomposed rings would ENGAGE at these shapes —
+            # the moe_layer dispatch predicate evaluated statically
+            stream["ring_engages"] = moe_a2a_applicable(
+                self.topology, B=batch, S=seq,
+                E=int(getattr(model_cfg, "num_experts", 0) or 0),
+                F=int(getattr(model_cfg, "ffn", 0) or 0),
+            )
+        return stream
+
+    def _compute_z3_prefetch_stream(self):
+        """Static per-step all-gather wire for the prefetched layer scan
+        (None when the knob/mesh leaves nothing to prefetch). Shapes, not
+        batch, set this stream — no per-seq cache needed."""
+        if self._z3_prefetch_puts is None:
+            return None
+        from .zero.prefetch import prefetch_wire_bytes_per_step
+
+        params_shape, tp_specs = self._z3_prefetch_shapes
+        return prefetch_wire_bytes_per_step(
+            params_shape,
+            tp_specs,
+            self.param_specs,
+            self.topology,
+            itemsize=jnp.dtype(self.compute_dtype).itemsize,
+            accum_steps=self.config.gradient_accumulation_steps,
+            remat=bool(self.remat_policy and self.remat_policy != "none"),
         )
 
     # ------------------------------------------------------------------ step
@@ -988,6 +1140,12 @@ class TpuEngine:
         from ..parallel.tensor_overlap import overlap_scope
 
         stack.enter_context(overlap_scope(self.tp_overlap))
+        from ..parallel.a2a_overlap import a2a_scope
+
+        stack.enter_context(a2a_scope(self.moe_a2a))
+        from .zero.prefetch import prefetch_scope
+
+        stack.enter_context(prefetch_scope(self._z3_prefetch_puts))
         return stack
 
     def _loss_for(self, params, mb, key, scale, pld_keep=None, ltd_keep=None):

@@ -13,7 +13,13 @@ trace-ready evidence of one statically-visible bug class:
   frontier) whose arena carry-out sharding drifts
 - ``missing_psum_grads``    R1: dp-local grads applied as if reduced
 - ``broken_ppermute_ring``  R3: a pipeline ring with a stray edge
+- ``moe_a2a_malformed_ring`` R3: a hand-rolled MoE dispatch-reduce ring
+  whose ep cycle closes on the wrong member (the a2a-overlap hazard;
+  the clean twin traces the real parallel/a2a_overlap.py program)
 - ``read_after_donate``     R4: a rotating slot read after overwrite
+- ``zero3_prefetch_stale_slot`` R4: a hand-rolled two-slot param-gather
+  prefetch whose layer compute reads the pre-overwrite slot generation
+  (the staleness the functional prefetch carry avoids by construction)
 - ``truncated_master``      R5: f32 master rebuilt through bf16
 - ``pinned_host_compute``   R5: host-resident bytes fed to compute
 - ``hbm_over_budget``       R6: estimated peak exceeds the HBM budget
@@ -414,6 +420,109 @@ def tp_overlap_ring_clean():
     return jax.make_jaxpr(prog)(x, w), {"mesh": topo.mesh}, "R3"
 
 
+# ------------------------------------------------------------------ R3 bis
+# decomposed MoE all-to-all (parallel/a2a_overlap.py): the clean twin
+# traces the REAL overlapped expert layer; the hazard is the same dispatch-
+# reduce ring hand-rolled with a raw lax.ppermute whose ep ring closes on
+# the wrong member (bypassing comm.collectives.permute's construction-time
+# contract — the exact mistake the hook exists to prevent)
+def _moe_topo():
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    return MeshTopology(dims=ParallelDims(dp=2, ep=4))
+
+
+def moe_a2a_malformed_ring():
+    topo = _moe_topo()
+    ep, E_loc, C, D = 4, 1, 8, 16
+    # ring 0→1→2→3 closed back to 1 instead of 0: duplicate destination —
+    # two members send to one, the exchange hangs on real ICI
+    perm = [(0, 1), (1, 2), (2, 3), (3, 1)]
+
+    def body(disp, tok):
+        i = lax.axis_index("ep")
+        n = tok.shape[0]
+
+        def part(blk):
+            d = lax.dynamic_slice(disp, (0, blk * E_loc, 0), (n, E_loc, C))
+            return jnp.einsum("nec,nd->ecd", d, tok)
+
+        acc = part((i - 1) % ep)
+        for s in range(1, ep):
+            acc = lax.ppermute(acc, "ep", perm)
+            acc = acc + part((i - 1 - s) % ep)
+        return lax.psum(acc, ("dp",))
+
+    fn = shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=(P(("dp", "ep"), None, None), P(("dp", "ep"), None)),
+        out_specs=P(None, None, None),
+        axis_names=set(topo.mesh.axis_names),
+        check_vma=False,
+    )
+    disp = jax.ShapeDtypeStruct((16, ep * E_loc, C), jnp.float32)
+    tok = jax.ShapeDtypeStruct((16, D), jnp.float32)
+    return jax.make_jaxpr(fn)(disp, tok), {"mesh": topo.mesh}, "R3"
+
+
+def moe_a2a_ring_clean():
+    from deepspeed_tpu.parallel.a2a_overlap import moe_a2a_ffn
+
+    topo = _moe_topo()
+    B, S, D, F, E, C = 2, 8, 16, 32, 4, 8
+
+    def prog(x, disp, comb, wi, wg, wo):
+        return moe_a2a_ffn(
+            x, ("einsum", disp, comb), (wi, wg, wo), topo,
+            chunks=2, bidirectional=True,
+        )
+
+    x = jax.ShapeDtypeStruct((B, S, D), jnp.float32)
+    disp = jax.ShapeDtypeStruct((B, S, E, C), jnp.float32)
+    comb = jax.ShapeDtypeStruct((B, S, E, C), jnp.float32)
+    wi = jax.ShapeDtypeStruct((E, D, F), jnp.float32)
+    wg = jax.ShapeDtypeStruct((E, D, F), jnp.float32)
+    wo = jax.ShapeDtypeStruct((E, F, D), jnp.float32)
+    return (
+        jax.make_jaxpr(prog)(x, disp, comb, wi, wg, wo),
+        {"mesh": topo.mesh},
+        "R3",
+    )
+
+
+# ------------------------------------------------------------------ R4 bis
+def _prefetch_slots(stale_read: bool):
+    """A hand-rolled two-slot ZeRO-3 gather prefetch: the rotating slot
+    buffer [2, d, d] is overwritten with the next layer's gathered params
+    via dynamic_update_slice each tick; the hazard reads the PRE-overwrite
+    generation — the layer computes with layer i-2's weights (exactly the
+    staleness the functional carry in runtime/zero/prefetch.py avoids by
+    construction)."""
+
+    def prog(slots, gathered):
+        def body(carry, layer_w):
+            buf = carry
+            new = lax.dynamic_update_slice(buf, layer_w[None], (0, 0, 0))
+            src = buf if stale_read else new
+            out = jnp.tanh(src[0]) * 0.5
+            return new, out
+
+        return lax.scan(body, slots, gathered)
+
+    slots = jax.ShapeDtypeStruct((2, 4, 4), jnp.float32)
+    gathered = jax.ShapeDtypeStruct((3, 4, 4), jnp.float32)
+    return jax.make_jaxpr(prog)(slots, gathered)
+
+
+def zero3_prefetch_stale_slot():
+    return _prefetch_slots(True), {}, "R4"
+
+
+def zero3_prefetch_stale_slot_clean():
+    return _prefetch_slots(False), {}, "R4"
+
+
 # --------------------------------------------------------------------- R6
 def _budget_prog():
     mesh = corpus_mesh()
@@ -555,6 +664,8 @@ HAZARDS = [
     truncated_master,
     pinned_host_compute,
     tp_overlap_malformed_ring,
+    moe_a2a_malformed_ring,
+    zero3_prefetch_stale_slot,
     hbm_over_budget,
     autotuner_rung_oom,
     reshard_transpose_pair,
@@ -572,6 +683,8 @@ CLEAN_TWINS = [
     truncated_master_clean,
     pinned_host_compute_clean,
     tp_overlap_ring_clean,
+    moe_a2a_ring_clean,
+    zero3_prefetch_stale_slot_clean,
     hbm_over_budget_clean,
     autotuner_rung_oom_clean,
     reshard_transpose_pair_clean,

@@ -49,8 +49,13 @@ def test_candidate_space_enumeration():
             "autotuning": {"max_train_micro_batch_size_per_gpu": 4}}
     cands = _search(base).candidates()
     labels = {c.label() for c in cands}
-    assert len(cands) == len(ZERO_LADDER) * len(REMAT_POLICIES) * 3
-    assert "z0/none/mb1" in labels and "z3off/full/mb4" in labels
+    # the stage-3 ladder rungs double for the layer-prefetch on/off axis
+    n_stage3 = sum(1 for z in ZERO_LADDER if z["stage"] == 3)
+    ladder_units = len(ZERO_LADDER) + n_stage3
+    assert len(cands) == ladder_units * len(REMAT_POLICIES) * 3
+    assert "z0/none/mb1" in labels and "z3off/full/mb4/z3pf" in labels
+    assert {c.z3_prefetch for c in cands if c.stage == 3} == {False, True}
+    assert all(c.z3_prefetch is None for c in cands if c.stage != 3)
 
     pinned = dict(base, zero_optimization={"stage": 1})
     cands = _search(pinned).candidates()
@@ -62,9 +67,57 @@ def test_candidate_space_enumeration():
     assert len(cands) == len(REMAT_POLICIES) * 3 * 2
     assert {c.tp_overlap for c in cands} == {False, True}
 
+    # expert parallelism adds the decomposed-a2a on/off axis (ISSUE 10)
+    moe = dict(pinned, moe={"enabled": True, "ep_size": 2,
+                            "num_experts": 4})
+    cands = _search(moe).candidates()
+    assert len(cands) == len(REMAT_POLICIES) * 3 * 2
+    assert {c.moe_a2a for c in cands} == {False, True}
+    assert any("a2aov" in c.label() for c in cands)
+
     serving = dict(base, serving={"enabled": True})
     cands = _search(serving, token_budgets=(8, 32)).candidates()
     assert [c.token_budget for c in cands] == [8, 32]
+
+
+def test_new_overlap_axes_reach_plans_and_configs(devices8):
+    """The ISSUE-10 axes are real: the built candidate config carries the
+    flags, the abstract trace prices both settings (R6/R8 run before any
+    compile), and the a2a-on plan declares the overlapped moe_a2a stream
+    while the off leg declares it serial."""
+    from deepspeed_tpu.autotuning import PlannerSearch
+    from deepspeed_tpu.models import mixtral
+
+    model = mixtral("mixtral-tiny", vocab_size=64, max_seq_len=16,
+                    num_experts=2)
+    base = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 1},
+        "moe": {"enabled": True, "ep_size": 2, "num_experts": 2},
+        "autotuning": {"max_train_micro_batch_size_per_gpu": 1,
+                       "tune_zero": False},
+    }
+    search = PlannerSearch(model, base, None, top_k=1)
+    cands = search.candidates()
+    assert {(c.moe_a2a, c.z3_prefetch) for c in cands} == {
+        (False, False), (False, True), (True, False), (True, True),
+    }
+    on = next(c for c in cands if c.moe_a2a and c.z3_prefetch)
+    cfg = search._candidate_config(on)
+    assert cfg["moe"]["overlap_a2a"]["enabled"]
+    assert cfg["zero_optimization"]["stage3_layer_prefetch"]
+    res = search.search()
+    by_label = {p.cand.label(): p for p in res.planned}
+    p_on = next(p for p in res.planned
+                if p.cand.moe_a2a and p.cand.z3_prefetch)
+    p_off = next(p for p in res.planned
+                 if not p.cand.moe_a2a and not p.cand.z3_prefetch)
+    assert p_on.plan is not None and p_off.plan is not None, by_label
+    assert p_on.plan.streams["moe_a2a"]["overlapped"]
+    assert p_on.plan.streams["zero3_prefetch"]["overlapped"]
+    assert not p_off.plan.streams["moe_a2a"]["overlapped"]
+    assert "zero3_prefetch" not in p_off.plan.streams
 
 
 # --------------------------------------------------- prune + rank + explain

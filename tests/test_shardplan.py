@@ -164,6 +164,29 @@ def test_plan_reports_offload_and_ring_streams(devices8):
     assert ring["overlapped"] and ring["kind"] == "ici"
     assert plan.ici_bytes_total > 0  # the walk saw the ppermute hops
 
+    # ISSUE-10: the MoE dispatch/combine exchange is declared on BOTH
+    # paths (the serial GSPMD path moves the same logical bytes — R8
+    # must see them either way), overlapped only with the knob on
+    model, cfg = targets["bench-moe-a2a"]
+    plan = plan_engine(_engine(cfg, model=model), source="moe")
+    a2a = plan.streams["moe_a2a"]
+    assert a2a["overlapped"] and a2a["kind"] == "ici"
+    assert a2a["per_device_bytes_per_step"] > 0
+    import copy
+
+    cfg_off = copy.deepcopy(cfg)
+    cfg_off["moe"]["overlap_a2a"]["enabled"] = False
+    plan_off = plan_engine(_engine(cfg_off, model=model), source="moe-ser")
+    a2a_off = plan_off.streams["moe_a2a"]
+    assert not a2a_off["overlapped"]
+    assert a2a_off["bytes_per_step"] == a2a["bytes_per_step"]
+
+    model, cfg = targets["bench-410m-z3-prefetch"]
+    plan = plan_engine(_engine(cfg, model=model), source="z3pf")
+    z3 = plan.streams["zero3_prefetch"]
+    assert z3["overlapped"] and z3["kind"] == "ici"
+    assert z3["per_device_bytes_per_step"] > 0 and z3["slots"] == 2
+
 
 def test_r6_fires_only_with_budget(devices8):
     """No budget → R6 silent; a budget below the estimated peak → R6

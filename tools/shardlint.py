@@ -44,15 +44,27 @@ if REPO_DIR not in sys.path:
 
 def default_model_for(cfg):
     """A tiny model shaped to satisfy the config's structural demands
-    (layer count divisible by pipeline stages). Lint findings are about
-    the *step program structure*, which the config — not the model size —
-    determines."""
-    from deepspeed_tpu.models import gpt2
-
+    (layer count divisible by pipeline stages; a routed-expert MLP with
+    ep-divisible experts when the config enables MoE — a dense model
+    would trace no expert exchange and the moe lint would be vacuous).
+    Lint findings are about the *step program structure*, which the
+    config — not the model size — determines."""
     stages = max(1, cfg.pipeline.stages)
     layers = max(4, stages * 2)
     if layers % stages:
         layers = stages * ((layers // stages) + 1)
+    if cfg.moe.enabled:
+        from deepspeed_tpu.models import mixtral
+
+        return mixtral(
+            "mixtral-tiny",
+            vocab_size=512,
+            max_seq_len=64,
+            num_layers=layers,
+            num_experts=max(2, cfg.moe.ep_size, cfg.moe.num_experts),
+        )
+    from deepspeed_tpu.models import gpt2
+
     return gpt2(
         "gpt2-tiny",
         vocab_size=512,
