@@ -560,11 +560,15 @@ def plan_summary(engine, name: str, measured_step_s=None,
                 )
                 entry = drift.make_entry(plan, measured_step_s, source=name)
                 ledger.append(entry)
-                lo, hi = drift.band_for(plan.hardware.gen)
+                # the ONE drifted-pair predicate (shared with the ledger
+                # gate and the healthwatch live alarm — ISSUE 11)
+                verdict = drift.check_pair(
+                    None, None, plan.hardware.gen, ratio=entry["ratio"]
+                )
                 out["drift"] = {
                     "ratio": entry["ratio"],
-                    "band": [round(lo, 4), round(hi, 4)],
-                    "ok": bool(entry["ratio"] and lo <= entry["ratio"] <= hi),
+                    "band": [round(b, 4) for b in verdict["band"]],
+                    "ok": verdict["ok"],
                 }
                 recal = drift.recalibration_suggestion(
                     ledger.load(gen=plan.hardware.gen)
@@ -610,6 +614,46 @@ def trace_phase_table(engine, data, tag: str):
         return {"trace": path, "phase_mean_s": phases}
     except Exception as e:  # noqa: BLE001
         print(f"bench: steptrace phase table skipped: "
+              f"{(str(e).splitlines() or [repr(e)])[0][:160]}",
+              file=sys.stderr)
+        return None
+
+
+def healthwatch_goodput(engine, data, predicted_step_s=None):
+    """Goodput-accounting column for the BENCH record (ISSUE 11): enable
+    healthwatch post-measurement (its device-scalar taps would otherwise
+    perturb the banked number), run 2 watched steps, report the bucket
+    split + running goodput fraction — and, when the plan table already
+    priced this engine, arm the live drift alarm with its prediction so
+    the plan_drift watchdog exercises end-to-end. Best-effort: a bench
+    number must never die on its accounting line."""
+    try:
+        # plan_drift must actually evaluate inside this 2-step window:
+        # its default min_samples (4) would silently skip it
+        hw = engine.enable_healthwatch(
+            install_signal_handler=False,
+            rules={"plan_drift": {"min_samples": 2, "window": 2}},
+        )
+        if predicted_step_s:
+            from deepspeed_tpu.analysis.cost import HardwareModel
+
+            hw.set_prediction(predicted_step_s, HardwareModel.detect().gen)
+        for _ in range(2):
+            engine.train_batch(batch=data)
+        g = hw.goodput()
+        print(
+            f"bench: goodput {g['goodput_fraction']:.4f} over "
+            f"{g['elapsed_s']:.2f}s — " + ", ".join(
+                f"{k}={v:.3f}s" for k, v in g["buckets"].items()
+            ),
+            file=sys.stderr,
+        )
+        col = {"goodput": g["goodput_fraction"], "buckets": g["buckets"]}
+        if hw.events:
+            col["anomalies"] = [e["rule"] for e in hw.events]
+        return col
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: healthwatch goodput skipped: "
               f"{(str(e).splitlines() or [repr(e)])[0][:160]}",
               file=sys.stderr)
         return None
@@ -784,6 +828,12 @@ def main():
     # phase breakdown rides along with the plan table (traced steps run
     # after the timed window, so the fences cannot touch the record)
     steptrace_col = trace_phase_table(engine, data, model_tag())
+    # goodput accounting + the live drift alarm ride the same
+    # post-measurement window (ISSUE 11)
+    health_col = healthwatch_goodput(
+        engine, data,
+        predicted_step_s=(plan or {}).get("est_step_s"),
+    )
     if offload is not None and os.environ.get("BENCH_OFFLOAD_AB") and big:
         # A/B the double-buffer knob in the same window: rebuild the
         # engine (the 1.5B state doesn't fit twice) with the knob flipped
@@ -872,6 +922,11 @@ def main():
         # the BENCH record's phase-breakdown column (ISSUE 8): per-phase
         # mean seconds from the traced post-measurement steps
         result["steptrace"] = steptrace_col
+    if health_col is not None:
+        # the goodput column (ISSUE 11): wall-clock bucket split +
+        # running goodput fraction from the watched post-measurement
+        # steps (see docs/observability.md "healthwatch")
+        result["healthwatch"] = health_col
     if not smoke:
         note = bank_record(cls, result)
         if note:

@@ -60,6 +60,37 @@ def band_for(gen: str) -> Tuple[float, float]:
     return BANDS.get(gen, DEFAULT_BAND)
 
 
+def check_pair(predicted: Optional[float], measured: Optional[float],
+               gen: str, *, ratio: Optional[float] = None,
+               band: Optional[Tuple[float, float]] = None
+               ) -> Dict[str, Any]:
+    """ONE (predicted, measured) pair against its generation's band —
+    THE definition of "drifted", shared by the offline ledger gate
+    (:func:`check`), bench.py's per-run verdict and the healthwatch
+    live drift alarm (profiling/healthwatch.py ``plan_drift``), so the
+    band constants exist exactly once.
+
+    Returns ``{"ok", "ratio", "band", "gen"}``; an unmeasurable pair
+    (measured <= 0 / None) yields ``ratio None, ok False``. Callers
+    holding a precomputed ratio (ledger rows) pass ``ratio=``; ``band=``
+    overrides the generation lookup (the gate's --band flag)."""
+    if ratio is None and predicted is not None and measured:
+        try:
+            if float(measured) > 0:
+                ratio = float(predicted) / float(measured)
+        except (TypeError, ValueError):
+            ratio = None
+    lo, hi = band if band is not None else band_for(gen)
+    ok = isinstance(ratio, (int, float)) and lo <= ratio <= hi
+    return {
+        "ok": bool(ok),
+        "ratio": round(ratio, 6) if isinstance(ratio, (int, float))
+        else None,
+        "band": (round(lo, 6), round(hi, 6)),
+        "gen": gen,
+    }
+
+
 def default_ledger_path() -> str:
     """``SHARDPLAN_DRIFT_LEDGER`` env override, else a stable per-user
     cache location — NOT the cwd: planner-mode autotuning auto-engages
@@ -187,8 +218,12 @@ def check(entries: Sequence[Dict[str, Any]],
             problems.append(f"{r.get('source', '?')}: unmeasurable entry "
                             f"(ratio={ratio!r})")
             continue
-        lo, hi = band or band_for(r.get("gen", ""))
-        if not lo <= ratio <= hi:
+        # the ONE drifted-pair predicate (shared with the healthwatch
+        # live alarm and bench's per-run verdict)
+        verdict = check_pair(None, None, r.get("gen", ""), ratio=ratio,
+                             band=band)
+        if not verdict["ok"]:
+            lo, hi = verdict["band"]
             problems.append(
                 f"{r.get('source', '?')}: predicted/measured step ratio "
                 f"{ratio:.3f} outside [{lo:.3g}, {hi:.3g}] "

@@ -455,6 +455,81 @@ class SteptraceConfig:
 
 
 @dataclass
+class HealthwatchConfig:
+    """"healthwatch" section — always-on goodput accounting, anomaly
+    watchdogs and the flight-recorder postmortem
+    (profiling/healthwatch.py, docs/observability.md "healthwatch").
+    Enabling healthwatch implies steptrace (the goodput buckets are
+    classified off the engine's own spans). MUST be zero-overhead when
+    disabled: engines keep ``healthwatch = None``, no ring buffer is
+    allocated, no span is added and no device scalar is read — the loss
+    trajectory is bitwise identical to a no-healthwatch engine."""
+
+    enabled: bool = False
+    ring_steps: int = 64       # flight-recorder depth: last K steps of
+                               # spans/metrics/watchdog evaluations
+    rules: Dict[str, Any] = field(default_factory=dict)
+                               # per-rule overrides merged over
+                               # healthwatch.DEFAULT_RULES, e.g.
+                               # {"queue_depth_breach": {"threshold": 32,
+                               #                         "action": "dump"}}
+    export_path: Optional[str] = None  # metrics export target; "*.prom"
+                               # writes Prometheus textfile format,
+                               # anything else appends JSON-lines
+    export_interval_s: float = 10.0    # min seconds between flushes
+                               # (0 = flush every step)
+    postmortem_path: Optional[str] = None  # default dump target
+                               # (healthwatch_postmortem_<source>.json)
+    install_signal_handler: bool = True  # chain SIGTERM + excepthook so
+                               # preemption/crash still dumps evidence
+
+    def validate(self) -> None:
+        if int(self.ring_steps) < 1:
+            raise DeepSpeedConfigError(
+                f"healthwatch.ring_steps must be >= 1, got "
+                f"{self.ring_steps}"
+            )
+        if float(self.export_interval_s) < 0:
+            raise DeepSpeedConfigError(
+                "healthwatch.export_interval_s must be >= 0, got "
+                f"{self.export_interval_s}"
+            )
+        if not isinstance(self.rules, dict):
+            raise DeepSpeedConfigError(
+                f"healthwatch.rules must be a dict, got "
+                f"{type(self.rules).__name__}"
+            )
+        from .profiling.healthwatch import (ACTIONS, DEFAULT_RULES,
+                                            SEVERITIES)
+
+        for name, params in self.rules.items():
+            if name not in DEFAULT_RULES:
+                raise DeepSpeedConfigError(
+                    f"healthwatch.rules: unknown rule {name!r} "
+                    f"(known: {sorted(DEFAULT_RULES)})"
+                )
+            if isinstance(params, bool):
+                continue
+            if not isinstance(params, dict):
+                raise DeepSpeedConfigError(
+                    f"healthwatch.rules.{name} must be a dict or bool, "
+                    f"got {type(params).__name__}"
+                )
+            action = params.get("action")
+            if action is not None and action not in ACTIONS:
+                raise DeepSpeedConfigError(
+                    f"healthwatch.rules.{name}.action must be one of "
+                    f"{ACTIONS}, got {action!r}"
+                )
+            sev = params.get("severity")
+            if sev is not None and sev not in SEVERITIES:
+                raise DeepSpeedConfigError(
+                    f"healthwatch.rules.{name}.severity must be one of "
+                    f"{SEVERITIES}, got {sev!r}"
+                )
+
+
+@dataclass
 class FlopsProfilerConfig:
     enabled: bool = False
     profile_step: int = 1
@@ -730,6 +805,7 @@ class DeepSpeedConfig:
         )
         self.checkpoint = _parse_dc(CheckpointConfig, d.get("checkpoint"))
         self.steptrace = _parse_dc(SteptraceConfig, d.get("steptrace"))
+        self.healthwatch = _parse_dc(HealthwatchConfig, d.get("healthwatch"))
         self.flops_profiler = _parse_dc(FlopsProfilerConfig, d.get("flops_profiler"))
         self.comms_logger = _parse_dc(CommsLoggerConfig, d.get("comms_logger"))
         self.monitor = MonitorConfig(
@@ -850,6 +926,7 @@ class DeepSpeedConfig:
         self.sparse_attention.validate()
         self.checkpoint.validate()
         self.steptrace.validate()
+        self.healthwatch.validate()
         if self.sparse_attention.mode not in ("none", "dense") and (
             self.sequence_parallel.sp_size > 1
         ):

@@ -1,4 +1,6 @@
 from .comm_logger import CommsLogger  # noqa: F401
 from .flops_profiler import FlopsProfiler  # noqa: F401
+from .healthwatch import (HealthWatch, HealthwatchAnomaly,  # noqa: F401
+                          MetricsExporter)
 from .steptrace import (MetricsRegistry, ServeTracer,  # noqa: F401
                         get_registry)

@@ -19,8 +19,10 @@ module is the substrate they all feed into:
 - **Namespaces** are the one coherent scheme every backend sees:
   ``train/*`` (engine step phases + step metrics), ``serve/*`` (serving
   step phases, request lifecycles, serving metrics), ``comm/*``
-  (collective / analytic-stream accounting) and ``plan/*`` (shardplan
-  predictions attached to the trace). :func:`write_events` is the ONE
+  (collective / analytic-stream accounting), ``plan/*`` (shardplan
+  predictions attached to the trace) and ``health/*`` (healthwatch
+  goodput + watchdog events — profiling/healthwatch.py).
+  :func:`write_events` is the ONE
   monitor bridge — ServingMetrics.write_to and CommsLogger.write_to
   route through it, so TensorBoard/W&B/CSV files share the namespace.
 - **Export** is Chrome trace-event JSON (``registry.export(path)``,
@@ -232,6 +234,13 @@ class MetricsRegistry:
                 self.dropped += 1
                 return
             self.samples.append((tag, float(value), step, self.clock()))
+
+    def samples_since(self, cursor: int):
+        """(new_cursor, samples[cursor:]) — the healthwatch exporter's
+        incremental intake: each flush picks up only the metric samples
+        recorded since its last one."""
+        with self._lock:
+            return len(self.samples), list(self.samples[cursor:])
 
     def write_events(self, monitor, events) -> None:
         """THE monitor bridge: record the (tag, value, step) triples as

@@ -259,6 +259,11 @@ class TpuEngine:
                 max_spans=config.steptrace.max_spans
             )
             self._steptrace_export_path = config.steptrace.export_path
+        # healthwatch (config-gated; docs/observability.md "healthwatch").
+        # None is the zero-overhead path: no ring buffer, no device-scalar
+        # taps, no extra spans — constructed below AFTER the analytic
+        # streams exist (its comm-exposed goodput bucket prices them).
+        self.healthwatch = None
         if config.comms_logger.enabled:
             from ..profiling.comm_logger import CommsLogger
 
@@ -752,6 +757,8 @@ class TpuEngine:
         self._moe_a2a_streams = {}
         self.moe_a2a_stream = self._compute_moe_a2a_stream()
         self.z3_prefetch_stream = self._compute_z3_prefetch_stream()
+        if config.healthwatch.enabled and not self.abstract:
+            self._build_healthwatch(config.healthwatch)
         if self._nvme_swapper is not None and not self.abstract:
             # optimizer state lives on disk between steps (reference:
             # partitioned_optimizer_swapper); swapped in around each update
@@ -759,6 +766,13 @@ class TpuEngine:
 
         self._replicated = NamedSharding(topology.mesh, P())
         self._data_iters: Dict[int, Any] = {}
+        # retrace counter (the serving engine's step_traces discipline):
+        # a trace-time side effect fires once per XLA compile of the
+        # jitted step programs — healthwatch's recompile watchdog and the
+        # goodput compile bucket read the per-step delta
+        self.step_traces = 0
+        self._last_seq: Optional[int] = None
+        self._mfu_cache: Dict[str, Any] = {}
         self._compile_step_fns()
         n_params = sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(params_shape))
         log_dist(
@@ -1495,8 +1509,24 @@ class TpuEngine:
             self._replicated,
         )
         self._state_shardings = state_shardings
+
+        def _counted(fn):
+            # trace-time side effect: fires once per XLA compile, so the
+            # per-step delta of self.step_traces is the retrace count
+            # (healthwatch recompile watchdog + goodput compile bucket).
+            # wraps() keeps the compiled program's name (HLO dumps and
+            # profiler traces must not all read "jit_wrapped").
+            import functools
+
+            @functools.wraps(fn)
+            def wrapped(*args):
+                self.step_traces += 1
+                return fn(*args)
+
+            return wrapped
+
         self._jit_train = jax.jit(
-            self._train_step,
+            _counted(self._train_step),
             donate_argnums=(0, 1, 2, 3),
             static_argnums=(6,),  # random-LTD kept-token count
             out_shardings=(*state_shardings, None),
@@ -1509,10 +1539,10 @@ class TpuEngine:
             # disk swap-in while the device computes, then dispatches the
             # update. Swap-out writes overlap the next step.
             self._jit_grads = jax.jit(
-                self._grads_and_loss, static_argnums=(5,)
+                _counted(self._grads_and_loss), static_argnums=(5,)
             )
             self._jit_update = jax.jit(
-                self._apply_update,
+                _counted(self._apply_update),
                 donate_argnums=(0, 1, 2, 3),
                 out_shardings=(*state_shardings, None),
             )
@@ -1590,11 +1620,20 @@ class TpuEngine:
         yielding them (``data_iter=``).
         """
         self._check_concrete("train_batch")
+        hw = self.healthwatch
+        tr = self.tracer
+        if hw is not None:
+            hw.on_step_start()
         self.tput.start()
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs data_iter or batch")
+            # input-wait instrumentation (ISSUE 11): the iterator pull is
+            # the data stall — healthwatch's stall_on_data goodput bucket
+            in_sp = tr.begin("train/input_wait", "train") if tr else None
             batch = self._next_batch(data_iter)
+            if in_sp is not None:
+                in_sp.end()
         if "labels" not in batch:
             from ..models.transformer import make_lm_batch
 
@@ -1623,7 +1662,6 @@ class TpuEngine:
                 for k, v in batch.items()
             }
         breakdown = self.config.wall_clock_breakdown
-        tr = self.tracer
         step_sp = (
             tr.begin("train/step", "train", {"step": self.global_steps + 1})
             if tr else None
@@ -1632,6 +1670,7 @@ class TpuEngine:
             self.timers("batch_prep").start()
         prep_sp = tr.begin("train/batch_prep", "train") if tr else None
         prepared = self._prepare_batch(batch)
+        self._last_seq = int(prepared["input_ids"].shape[-1])
         if prep_sp is not None:
             prep_sp.end()
         if breakdown:
@@ -1648,6 +1687,7 @@ class TpuEngine:
                 ltd_keep = None  # schedule annealed past full length
         if breakdown:
             self.timers("step_dispatch").start()
+        traces_before = self.step_traces
         with use_topology(self.topology):
             if self._nvme_swapper is not None:
                 # dispatch grads async, then overlap the NVMe swap-in with
@@ -1663,16 +1703,23 @@ class TpuEngine:
                     prepared, self.next_rng(), ltd_keep,
                 )
                 if sp is not None:
+                    if self.step_traces != traces_before:
+                        # a retrace happened inside this dispatch —
+                        # healthwatch books the span as compile time
+                        sp.annotate(traced=self.step_traces - traces_before)
                     sp.end()
                     sp = tr.begin("train/offload_swap_in", "train")
                 self._swap_in_opt()
                 if sp is not None:
                     sp.end()
                     sp = tr.begin("train/optimizer_dispatch", "train")
+                traces_mid = self.step_traces
                 p, o, s, st, metrics = self._jit_update(
                     *self.state.astuple(), grads, loss, mmetrics
                 )
                 if sp is not None:
+                    if self.step_traces != traces_mid:
+                        sp.annotate(traced=self.step_traces - traces_mid)
                     sp.end()
             else:
                 sp = tr.begin("train/dispatch", "train") if tr else None
@@ -1680,6 +1727,10 @@ class TpuEngine:
                     *self.state.astuple(), prepared, self.next_rng(), ltd_keep
                 )
                 if sp is not None:
+                    if self.step_traces != traces_before:
+                        # a retrace happened inside this dispatch —
+                        # healthwatch books the span as compile time
+                        sp.annotate(traced=self.step_traces - traces_before)
                     sp.end()
         if tr is not None:
             # fence at close: the async-dispatched fwd/bwd/optimizer work
@@ -1726,6 +1777,16 @@ class TpuEngine:
         self.tput.stop()
         if step_sp is not None:
             step_sp.end()
+        if hw is not None:
+            # healthwatch tick AFTER the step span closed: the device
+            # fence already ran, so the loss/grad taps read finished
+            # values (exactly 2 host scalar transfers per step)
+            hw.on_train_step(
+                step=self.global_steps,
+                loss=metrics["loss"],
+                grad_norm=metrics["grad_norm"],
+                compiled=self.step_traces - traces_before,
+            )
         return metrics["loss"]
 
     def _emit_step_log(self, metrics, step_no: int):
@@ -1737,12 +1798,14 @@ class TpuEngine:
         show_moe = "moe_aux_loss" in metrics and getattr(
             getattr(self.model, "config", None), "is_moe", False
         )
-        if self.monitor:
-            from ..profiling.steptrace import write_events
+        from ..profiling.steptrace import get_registry, write_events
 
+        if self.monitor or get_registry() is not None:
             # the documented train/* namespace, routed through the
             # steptrace registry's single monitor bridge (one coherent
-            # scheme with serve/* / comm/* / plan/*)
+            # scheme with serve/* / comm/* / plan/* / health/*); a traced
+            # run records the events as registry samples even with no
+            # monitor backend, so MFU/goodput land in the health export
             events = [
                 ("train/loss", float(metrics["loss"]), step_no),
                 ("train/lr", float(metrics["lr"]), step_no),
@@ -1758,10 +1821,21 @@ class TpuEngine:
                     "train/samples_per_sec", self.tput.avg_samples_per_sec,
                     step_no,
                 ))
+            mfu = self._train_mfu()
+            if mfu is not None:
+                # flops_profiler MFU wired through the one registry
+                # (ISSUE 11 satellite): MFU, goodput and drift appear
+                # side-by-side in one export
+                events.append(("train/mfu", float(mfu), step_no))
+            if self.healthwatch is not None:
+                events.append((
+                    "train/goodput",
+                    self.healthwatch.goodput_fraction(), step_no,
+                ))
             write_events(self.monitor, events)
-            if self.comm_logger is not None:
+            if self.comm_logger is not None and self.monitor is not None:
                 self.comm_logger.write_to(self.monitor, step_no)
-        else:
+        if self.monitor is None:
             aux = (
                 f" moe_aux={float(metrics['moe_aux_loss']):.4f}" if show_moe else ""
             )
@@ -2027,6 +2101,93 @@ class TpuEngine:
         log_dist(f"steptrace: wrote {out}")
         return out
 
+    # -------------------------------------------------------- healthwatch
+    def _build_healthwatch(self, hw_cfg):
+        """Construct the health layer (profiling/healthwatch.py). It
+        rides the steptrace registry — enabling healthwatch turns
+        tracing on so the goodput buckets can be classified off this
+        engine's own spans."""
+        from ..profiling import healthwatch as _healthwatch
+        from ..profiling import steptrace as _steptrace
+
+        if self.tracer is None:
+            self.tracer = _steptrace.configure(
+                max_spans=self.config.steptrace.max_spans
+            )
+            if self.comm_logger is not None:
+                self.comm_logger.registry = self.tracer
+        self.healthwatch = _healthwatch.HealthWatch(
+            hw_cfg, self.tracer, source="train",
+            context={"config": self.config.to_dict()},
+        )
+        self.healthwatch.set_comm_estimate_from_streams(
+            self.analytic_streams()
+        )
+        return self.healthwatch
+
+    def enable_healthwatch(self, **overrides):
+        """Attach healthwatch AFTER construction (bench.py's goodput leg
+        turns it on post-measurement so the watchdog taps never perturb
+        the banked number). ``overrides`` merge over the config's
+        ``healthwatch`` section; ``enabled`` is forced on."""
+        if self.healthwatch is not None:
+            return self.healthwatch
+        from ..config import HealthwatchConfig, _parse_dc
+
+        section = dict(self.config.raw.get("healthwatch") or {})
+        section.update(overrides)
+        section["enabled"] = True
+        cfg = _parse_dc(HealthwatchConfig, section)
+        cfg.validate()
+        return self._build_healthwatch(cfg)
+
+    def dump_postmortem(self, path: Optional[str] = None,
+                        reason: str = "explicit") -> Optional[str]:
+        """Write the flight-recorder postmortem JSON (render/validate
+        with tools/healthwatch.py; docs/observability.md)."""
+        if self.healthwatch is None:
+            raise RuntimeError(
+                "healthwatch is not enabled on this engine — set "
+                '{"healthwatch": {"enabled": true}} in the config or '
+                "call enable_healthwatch() first"
+            )
+        return self.healthwatch.dump_postmortem(path=path, reason=reason)
+
+    def _train_mfu(self) -> Optional[float]:
+        """Model-flops utilization from the throughput timer plus the
+        flops profiler's analytic per-step flops (fwd+bwd = 3x fwd),
+        priced against the hardware table's peak — the ISSUE-11
+        satellite that puts MFU next to goodput and drift in one
+        export. None until the timer warms up or when the model has no
+        TransformerConfig-shaped config."""
+        sps = self.tput.avg_samples_per_sec
+        mc = getattr(self.model, "config", None)
+        if sps <= 0 or mc is None or self._last_seq is None:
+            return None
+        key = (self.config.train_batch_size, self._last_seq)
+        if key not in self._mfu_cache:
+            # dict cache per (batch, seq): bucketed-seqlen runs must not
+            # re-profile the model at every print boundary
+            try:
+                from ..analysis.cost.hardware import HardwareModel
+                from ..profiling.flops_profiler import get_model_profile
+
+                flops, _macs, _params = get_model_profile(
+                    self.model, key[0], key[1], fwd_only=False
+                )
+                self._mfu_cache[key] = (
+                    float(flops),
+                    float(HardwareModel.detect().peak_flops),
+                )
+            except Exception:  # noqa: BLE001 — telemetry must not
+                # crash the step loop on an exotic model shape
+                self._mfu_cache[key] = (0.0, 0.0)
+        flops, peak = self._mfu_cache[key]
+        if flops <= 0 or peak <= 0:
+            return None
+        step_s = self.config.train_batch_size / sps
+        return flops / step_s / peak
+
     # -- reference imperative protocol ---------------------------------------
     def forward(self, batch):
         """Parity: engine(batch) → loss in the engine's current train/eval
@@ -2204,6 +2365,10 @@ class TpuEngine:
         self._check_concrete("save_checkpoint")
         from .checkpointing import save_checkpoint as _save
 
+        # checkpoint time is its own goodput bucket (ISSUE 11): the
+        # span covers the swap-in, the gather/write and the swap-out
+        sp = (self.tracer.begin("train/checkpoint", "train")
+              if self.tracer is not None else None)
         if self._nvme_swapper is not None:
             self._swap_in_opt()
         try:
@@ -2213,6 +2378,8 @@ class TpuEngine:
         finally:
             if self._nvme_swapper is not None:
                 self._swap_out_opt()  # keep "on disk between steps" invariant
+            if sp is not None:
+                sp.end()
 
     def load_checkpoint(self, load_dir, tag=None, strict=True):
         from .checkpointing import load_checkpoint as _load
@@ -2227,6 +2394,9 @@ class TpuEngine:
     def destroy(self):
         """Parity: DeepSpeedEngine.destroy — release global hooks/writers so
         engines created in a loop don't accumulate loggers."""
+        if self.healthwatch is not None:
+            self.healthwatch.close()  # final exporter flush + unregister
+            self.healthwatch = None
         if self.comm_logger is not None:
             self.comm_logger.stop()
             self.comm_logger = None

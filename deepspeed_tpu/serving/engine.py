@@ -308,6 +308,7 @@ class ServingEngine:
         metrics: Optional[ServingMetrics] = None,
         comm_logger=None,
         steptrace=None,
+        healthwatch=None,
         **engine_kwargs,
     ):
         from ..config import ServingConfig, _parse_dc
@@ -397,6 +398,38 @@ class ServingEngine:
                 self._serve_tracer = _steptrace.ServeTracer(self.tracer)
                 self.metrics.tracer = self._serve_tracer
                 self._steptrace_export_path = stc.export_path
+        # ---- healthwatch (profiling/healthwatch.py; None = the zero-
+        # overhead path: no ring buffer, no watchdog taps, no spans).
+        # Enabling it implies tracing — goodput buckets classify off the
+        # serve/* spans — so a missing steptrace section turns one on. --
+        self.healthwatch = None
+        if healthwatch is not None:
+            from ..config import HealthwatchConfig
+
+            hwc = (
+                healthwatch if isinstance(healthwatch, HealthwatchConfig)
+                else _parse_dc(HealthwatchConfig, healthwatch)
+            )
+            hwc.validate()
+            if hwc.enabled:
+                from ..profiling import healthwatch as _healthwatch
+                from ..profiling import steptrace as _steptrace
+
+                if self.tracer is None:
+                    self.tracer = _steptrace.configure()
+                    self._serve_tracer = _steptrace.ServeTracer(self.tracer)
+                    self.metrics.tracer = self._serve_tracer
+                self.healthwatch = _healthwatch.HealthWatch(
+                    hwc, self.tracer, source="serve",
+                    context={"config": {"serving": {
+                        "max_slots": N, "token_budget": W,
+                        "paged": self.paged,
+                        "queue_limit": int(serving.queue_limit),
+                        "max_tokens": int(self.max_tokens),
+                        "spec_max_draft": int(self.max_draft),
+                    }}},
+                )
+                self.metrics.healthwatch = self.healthwatch
         self.scheduler = Scheduler(
             max_slots=N,
             token_budget=W,
@@ -470,6 +503,13 @@ class ServingEngine:
             f"tp={self.topology.tp_size}, spec="
             f"{f'ngram(k<={self.max_draft})' if self.max_draft else 'off'}"
         )
+        if self.healthwatch is not None:
+            # price comm-exposed goodput off the declared streams (only
+            # unoverlapped ici/offload kinds count — the KV arena's hbm
+            # stream IS the step's compute traffic, not exposed wire)
+            self.healthwatch.set_comm_estimate_from_streams(
+                self.analytic_streams()
+            )
 
     # ------------------------------------------------------------- intake
     def submit(self, request: Request) -> RequestState:
@@ -479,6 +519,22 @@ class ServingEngine:
     def step(self) -> List[RequestState]:
         """One scheduler plan + one jitted device step. Returns requests
         that FINISHED this step (their slots already recycled)."""
+        hw = self.healthwatch
+        if hw is None:
+            return self._step_inner()
+        hw.on_step_start()
+        traces_before = self.step_traces
+        steps_before = self.metrics.steps
+        finished = self._step_inner()
+        if self.metrics.steps > steps_before:
+            # a device step actually ran (idle ticks accrue as idle)
+            hw.on_serve_step(
+                step=self.metrics.steps, metrics=self.metrics,
+                compiled=self.step_traces - traces_before,
+            )
+        return finished
+
+    def _step_inner(self) -> List[RequestState]:
         tr = self.tracer
         if tr is None:
             plan = self.scheduler.plan()

@@ -25,18 +25,50 @@ Glossary (docs/serving.md):
 
 from __future__ import annotations
 
+import math
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
 
+def _finite(v, default: float = 0.0):
+    """Sanitize one reported value: NaN/inf (or an unconvertible input)
+    becomes ``default`` so the summary line and the CSV/monitor bridge
+    NEVER carry a NaN — an empty window reports 0, not poison. Integer
+    counters pass through unchanged (the snapshot JSON keeps its
+    shape: ``"submitted": 3``, not ``3.0``)."""
+    if isinstance(v, int):  # bool is an int too; both are finite
+        return v
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return default
+    return f if math.isfinite(f) else default
+
+
 def percentile(values: List[float], p: float) -> float:
-    """Nearest-rank percentile; 0.0 on empty input (summary never dies)."""
-    if not values:
+    """Nearest-rank percentile over the FINITE samples; 0.0 on an empty
+    (or all-non-finite) window — the summary never dies and never
+    reports NaN before the first request completes."""
+    xs = sorted(v for v in values if isinstance(v, (int, float))
+                and math.isfinite(v))
+    if not xs:
         return 0.0
-    xs = sorted(values)
     idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
     return xs[idx]
+
+
+def recent_percentile(values: List[float], p: float,
+                      window: int = 32) -> Optional[float]:
+    """Percentile over the trailing ``window`` finite samples, or None
+    when the window is empty — the healthwatch TTFT watchdog needs the
+    tri-state (None = "no evidence yet", never a fake 0 that would mask
+    a breach or fire one)."""
+    xs = [v for v in values[-int(window):]
+          if isinstance(v, (int, float)) and math.isfinite(v)]
+    if not xs:
+        return None
+    return percentile(xs, p)
 
 
 class ServingMetrics:
@@ -48,6 +80,10 @@ class ServingMetrics:
         # traced replay gets per-request QUEUED→PREFILL→DECODE→DONE span
         # trees for free; None (default) is the zero-overhead path
         self.tracer = None
+        # optional healthwatch (profiling/healthwatch.py): when the
+        # serving engine attaches one, snapshot()/summary() report its
+        # running goodput fraction; None is the zero-overhead path
+        self.healthwatch = None
         # counters
         self.submitted = 0
         self.admitted = 0
@@ -218,7 +254,7 @@ class ServingMetrics:
         return self.tokens_out / dur if dur > 0 else 0.0
 
     def snapshot(self) -> Dict[str, float]:
-        return {
+        snap = {
             "submitted": self.submitted,
             "admitted": self.admitted,
             "rejected": self.rejected,
@@ -250,6 +286,11 @@ class ServingMetrics:
             "mean_accepted_tokens_per_step":
                 self.mean_accepted_tokens_per_step,
         }
+        if self.healthwatch is not None:
+            snap["goodput"] = self.healthwatch.goodput_fraction()
+        # empty-window hardening: every reported value is finite — no
+        # NaN ever reaches the summary line or the CSV/monitor bridge
+        return {k: _finite(v) for k, v in snap.items()}
 
     def summary(self) -> str:
         """comm_logger-style table."""
@@ -267,7 +308,8 @@ class ServingMetrics:
             f"{'tpot':<18}p50={s['tpot_p50_s'] * 1e3:.1f}ms "
             f"p95={s['tpot_p95_s'] * 1e3:.1f}ms",
             f"{'gauges':<18}queue_depth={self.queue_depth} "
-            f"slot_occupancy={self.slot_occupancy:.2f}",
+            f"slot_occupancy={self.slot_occupancy:.2f}"
+            + (f" goodput={s['goodput']:.2f}" if "goodput" in s else ""),
         ]
         if self._num_pages:
             lines.append(

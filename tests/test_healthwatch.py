@@ -1,0 +1,560 @@
+"""healthwatch: goodput accounting, anomaly watchdogs, flight recorder
+(ISSUE 11).
+
+The tentpole contract: injected NaN loss, loss spike, forced recompile
+and a serving queue breach are each detected within one step/tick and
+produce a schema-valid postmortem containing the triggering step's
+spans; disabled healthwatch allocates zero health state, performs zero
+device-scalar taps, and reproduces the baseline loss trajectory
+bitwise. Satellites ride along: drift.check_pair (ONE "drifted"
+definition), serving-metrics empty-window hardening, and train/mfu
+through the registry.
+"""
+
+import importlib.util
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis.cost import drift
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.profiling import healthwatch, steptrace
+from deepspeed_tpu.profiling.healthwatch import HealthWatch, MetricsExporter
+from deepspeed_tpu.serving import Request, ServingEngine
+from deepspeed_tpu.serving.metrics import (ServingMetrics, percentile,
+                                           recent_percentile)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "healthwatch_tool", os.path.join(REPO, "tools", "healthwatch.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    steptrace.reset()
+    healthwatch.reset()
+    yield
+    steptrace.reset()
+    healthwatch.reset()
+
+
+def tiny_llama():
+    return llama(
+        "llama-tiny", vocab_size=64, max_seq_len=32, hidden_size=16,
+        num_layers=1, num_heads=2, num_kv_heads=2, head_dim=8,
+        intermediate_size=32,
+    )
+
+
+def tiny_engine(hw_section=None, **extra_cfg):
+    cfg = {
+        "train_batch_size": 8,  # divides the 8-device CPU test mesh
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+        **extra_cfg,
+    }
+    if hw_section is not None:
+        cfg["healthwatch"] = hw_section
+    engine, *_ = deepspeed_tpu.initialize(model=tiny_llama(), config=cfg)
+    return engine
+
+
+def train_data(seed=0, seq=32, batch=8):
+    return {"input_ids": np.random.RandomState(seed).randint(
+        0, 64, size=(batch, seq))}
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def synthetic_hw(rules=None, **over):
+    cfg = {"enabled": True, "ring_steps": over.pop("ring_steps", 32),
+           "install_signal_handler": False,
+           "rules": rules or {}, **over}
+    clk = FakeClock()
+    return HealthWatch(cfg, None, source="train", clock=clk), clk
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead oracle (acceptance): disabled => no health state, no
+# device taps, bitwise-identical loss trajectory
+# ---------------------------------------------------------------------------
+def test_disabled_is_zero_overhead_and_bitwise():
+    data = train_data()
+
+    def run(hw_section):
+        healthwatch.reset()
+        steptrace.reset()
+        engine = tiny_engine(hw_section)
+        losses = [np.asarray(engine.train_batch(batch=data))
+                  for _ in range(3)]
+        hw = engine.healthwatch
+        engine.destroy()
+        return losses, hw
+
+    taps0 = healthwatch.device_taps()
+    base, hw = run(None)                       # no healthwatch section
+    assert hw is None
+    assert healthwatch.device_taps() == taps0  # zero device-scalar taps
+    assert steptrace.get_registry() is None    # zero spans allocated
+
+    off, hw = run({"enabled": False})          # explicit disabled
+    assert hw is None
+    assert healthwatch.device_taps() == taps0
+    assert steptrace.get_registry() is None
+
+    on, hw = run({"enabled": True, "install_signal_handler": False})
+    assert hw is not None and len(hw.ring) == 3
+    assert healthwatch.device_taps() > taps0   # the watched run taps
+
+    for a, b, c in zip(base, off, on):
+        # the health layer never touches the compiled program: all three
+        # trajectories are the same float32 bits
+        assert a.tobytes() == b.tobytes() == c.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# seeded-fault oracle: NaN + recompile on a real engine
+# ---------------------------------------------------------------------------
+def test_nan_and_recompile_detected_with_postmortem(tmp_path):
+    pm_path = str(tmp_path / "pm.json")
+    engine = tiny_engine({
+        "enabled": True, "ring_steps": 8, "postmortem_path": pm_path,
+        "install_signal_handler": False,
+    })
+    hw = engine.healthwatch
+    data = train_data()
+    for _ in range(2):
+        engine.train_batch(batch=data)
+    assert hw.events == []                     # clean warmup: no firing
+
+    # forced recompile: a new input shape retraces the step program
+    short = train_data(seed=1, seq=16)
+    engine.train_batch(batch=short)
+    fired = [e["rule"] for e in hw.events]
+    assert "recompile" in fired                # detected within one step
+    assert hw.ring[-1]["compiled"] >= 1
+
+    # injected NaN loss: poison the params
+    engine.state.params = jax.tree.map(
+        lambda x: x * jnp.nan, engine.state.params
+    )
+    engine.train_batch(batch=short)
+    fired = [e["rule"] for e in hw.events]
+    assert "nonfinite_loss" in fired and "nonfinite_grad" in fired
+    nan_ev = next(e for e in hw.events if e["rule"] == "nonfinite_loss")
+    assert nan_ev["step"] == hw.ring[-1]["step"]  # within one step
+    assert hw.ring[-1]["spans"], "triggering step must carry its spans"
+
+    # the dump action left a schema-valid postmortem
+    assert os.path.exists(pm_path)
+    tool = _load_tool()
+    kind, pm = tool.load(pm_path)
+    assert kind == "postmortem"
+    assert tool.validate_postmortem(pm) == []
+    assert pm["reason"].startswith("watchdog:nonfinite_")
+    assert tool.main(["--validate", pm_path]) == 0
+    assert tool.main([pm_path]) == 0           # render table runs
+    # health/* events landed in the registry (one namespace with
+    # train/* — the monitor bridge sees them too)
+    reg = steptrace.get_registry()
+    tags = {t for t, _v, _s, _t in reg.samples}
+    assert "health/nonfinite_loss" in tags and "health/goodput" in tags
+    engine.destroy()
+
+
+# ---------------------------------------------------------------------------
+# synthetic watchdogs: spike / explosion / step-time / plan drift / ring
+# ---------------------------------------------------------------------------
+def test_loss_spike_and_grad_explosion():
+    hw, clk = synthetic_hw(rules={
+        "loss_spike": {"min_samples": 5, "zscore": 6.0},
+        "grad_explosion": {"min_samples": 5, "factor": 10.0},
+    })
+    for i in range(8):
+        hw.on_step_start()
+        clk.advance(0.1)
+        hw.on_train_step(step=i + 1, loss=2.0 + 0.01 * (i % 2),
+                         grad_norm=1.0)
+    assert hw.events == []
+    hw.on_step_start()
+    clk.advance(0.1)
+    hw.on_train_step(step=9, loss=50.0, grad_norm=40.0)
+    fired = [e["rule"] for e in hw.events]
+    assert "loss_spike" in fired and "grad_explosion" in fired
+    spike = next(e for e in hw.events if e["rule"] == "loss_spike")
+    assert spike["step"] == 9                  # detected within one step
+
+
+def test_step_time_regression_and_plan_drift():
+    hw, clk = synthetic_hw(rules={
+        "step_time_regression": {"min_samples": 3, "factor": 2.0},
+        "plan_drift": {"min_samples": 3, "window": 4},
+    })
+    hw.set_prediction(0.1, "cpu")  # cpu band [1/25, 25] (check_pair)
+    for i in range(4):
+        hw.on_step_start()
+        clk.advance(0.1)           # measured ~= predicted: drift ok
+        hw.on_train_step(step=i + 1, loss=2.0, grad_norm=1.0)
+    assert [e["rule"] for e in hw.events] == []
+    # a 10x slower step trips the trailing-window regression
+    hw.on_step_start()
+    clk.advance(1.0)
+    hw.on_train_step(step=5, loss=2.0, grad_norm=1.0)
+    assert "step_time_regression" in [e["rule"] for e in hw.events]
+    # drive measured far outside even the cpu band -> live drift alarm
+    for i in range(6):
+        hw.on_step_start()
+        clk.advance(30.0)          # predicted/measured ~ 1/300 < 1/25
+        hw.on_train_step(step=6 + i, loss=2.0, grad_norm=1.0)
+    drift_ev = [e for e in hw.events if e["rule"] == "plan_drift"]
+    assert drift_ev, "live drift alarm must fire outside the band"
+    assert list(drift_ev[0]["threshold"]) == [
+        pytest.approx(1 / 25.0), pytest.approx(25.0)
+    ]
+
+
+def test_ring_is_bounded_and_disabled_rules_stay_quiet():
+    hw, clk = synthetic_hw(ring_steps=4, rules={
+        "loss_spike": False,       # bool shorthand disables a rule
+        "step_time_regression": {"enabled": False},
+    })
+    for i in range(10):
+        hw.on_step_start()
+        clk.advance(0.001 if i < 9 else 10.0)
+        hw.on_train_step(step=i + 1, loss=1.0 if i < 9 else 1e9,
+                         grad_norm=1.0)
+    assert len(hw.ring) == 4                   # bounded flight recorder
+    fired = {e["rule"] for e in hw.events}
+    assert "loss_spike" not in fired
+    assert "step_time_regression" not in fired
+
+
+# ---------------------------------------------------------------------------
+# goodput classification
+# ---------------------------------------------------------------------------
+def test_goodput_bucket_classification():
+    reg = steptrace.MetricsRegistry()
+    hw = HealthWatch({"enabled": True, "install_signal_handler": False},
+                     reg, source="train")
+    hw._comm_est_s = 0.4   # statically-priced unoverlapped wire seconds
+    t0 = reg.clock()
+    reg.add_span("train/device", "train", t0, t0 + 1.0)
+    reg.add_span("train/dispatch", "train", t0, t0 + 0.5,
+                 args={"traced": 1})
+    reg.add_span("train/dispatch", "train", t0, t0 + 0.25)   # no retrace
+    reg.add_span("train/input_wait", "train", t0, t0 + 0.2)
+    reg.add_span("train/checkpoint", "train", t0, t0 + 0.3)
+    reg.add_span("train/offload_swap_in", "train", t0, t0 + 0.1)
+    hw.on_step_start()
+    hw.on_train_step(step=1, loss=1.0, grad_norm=1.0)
+    b = hw.goodput()["buckets"]
+    assert b["compute"] == pytest.approx(0.6, abs=1e-6)       # 1.0 - comm
+    assert b["comm_exposed"] == pytest.approx(0.5, abs=1e-6)  # 0.4 + swap
+    assert b["compile"] == pytest.approx(0.5, abs=1e-6)       # traced only
+    assert b["stall_on_data"] == pytest.approx(0.2, abs=1e-6)
+    assert b["checkpoint"] == pytest.approx(0.3, abs=1e-6)
+    assert 0.0 <= hw.goodput_fraction() <= 1.0
+
+
+def test_comm_estimate_only_prices_unoverlapped_wire():
+    hw, _clk = synthetic_hw()
+    hw.set_comm_estimate_from_streams({
+        "kv_cache": {"kind": "hbm", "overlapped": False,
+                     "bytes_per_step": 1 << 30},   # compute traffic: no
+        "tp_ring": {"kind": "ici", "overlapped": True,
+                    "bytes_per_step": 1 << 30},    # hidden wire: no
+        "moe_a2a": {"kind": "ici", "overlapped": False,
+                    "bytes_per_step": 1 << 30},    # exposed wire: YES
+    })
+    assert hw._comm_est_s > 0
+    only_hidden = synthetic_hw()[0]
+    only_hidden.set_comm_estimate_from_streams({
+        "kv_cache": {"kind": "hbm", "overlapped": False,
+                     "bytes_per_step": 1 << 30},
+        "tp_ring": {"kind": "ici", "overlapped": True,
+                    "bytes_per_step": 1 << 30},
+    })
+    assert only_hidden._comm_est_s == 0.0
+
+
+# ---------------------------------------------------------------------------
+# serving: queue breach + goodput in the metrics surface
+# ---------------------------------------------------------------------------
+def test_serving_queue_breach_detected_and_postmortem(tmp_path):
+    pm_path = str(tmp_path / "pm_serve.json")
+    engine = deepspeed_tpu.init_inference(
+        tiny_llama(), dtype=jnp.float32, max_tokens=32,
+        rng=jax.random.PRNGKey(0),
+    )
+    srv = ServingEngine(
+        engine=engine,
+        serving={"max_slots": 2, "token_budget": 16, "queue_limit": 16,
+                 "max_tokens": 32},
+        healthwatch={
+            "enabled": True, "ring_steps": 16,
+            "postmortem_path": pm_path,
+            "install_signal_handler": False,
+            "rules": {"queue_depth_breach": {"threshold": 1,
+                                             "action": "dump"}},
+        },
+    )
+    for i in range(6):
+        srv.submit(Request(request_id=f"r{i}",
+                           prompt=np.arange(4) % 32,
+                           max_new_tokens=3))
+    finished = srv.run_until_idle()
+    assert len(finished) == 6                  # the replay still drains
+    assert srv.step_traces == 1                # and never recompiles
+    hw = srv.healthwatch
+    assert hw.counters.get("queue_depth_breach", 0) >= 1
+    first = next(e for e in hw.events
+                 if e["rule"] == "queue_depth_breach")
+    assert first["step"] == 1                  # breach seen on tick one
+    snap = srv.metrics.snapshot()
+    assert "goodput" in snap and math.isfinite(snap["goodput"])
+    assert "goodput=" in srv.metrics.summary()
+
+    tool = _load_tool()
+    kind, pm = tool.load(pm_path)
+    assert kind == "postmortem"
+    assert tool.validate_postmortem(pm) == []
+    assert pm["reason"] == "watchdog:queue_depth_breach"
+    assert pm["source"] == "serve"
+    trig = next(r for r in pm["steps"] if r["step"] == first["step"])
+    assert trig["spans"], "triggering tick must carry its spans"
+    # dump is debounced: a breach persisting across consecutive ticks
+    # writes one postmortem per episode, not one per tick
+    assert hw.dump_count < hw.counters["queue_depth_breach"] \
+        or hw.counters["queue_depth_breach"] == 1
+
+
+# ---------------------------------------------------------------------------
+# exporter
+# ---------------------------------------------------------------------------
+def test_exporter_prom_and_jsonl(tmp_path):
+    reg = steptrace.MetricsRegistry()
+    reg.sample("train/loss", 2.5, step=1)
+    reg.sample("serve/tokens_per_s", 10.0, step=1)
+    prom = MetricsExporter(str(tmp_path / "h.prom"), interval_s=0.0)
+    prom.flush(reg, extra={"health/goodput": 0.5})
+    text = open(tmp_path / "h.prom").read()
+    assert "dstpu_train_loss 2.5" in text
+    assert "dstpu_serve_tokens_per_s 10" in text
+    assert "dstpu_health_goodput 0.5" in text
+    # a second flush rewrites (textfile-collector contract), and the
+    # incremental cursor picks up only NEW samples
+    reg.sample("train/loss", 3.5, step=2)
+    prom.flush(reg)
+    text = open(tmp_path / "h.prom").read()
+    assert "dstpu_train_loss 3.5" in text and "2.5" not in text
+
+    jl = MetricsExporter(str(tmp_path / "h.jsonl"), interval_s=0.0)
+    jl.flush(reg, extra={"health/goodput": 0.25})
+    jl.flush(reg)
+    rows = [json.loads(x) for x in open(tmp_path / "h.jsonl")]
+    assert len(rows) == 2
+    assert rows[-1]["metrics"]["train/loss"] == 3.5
+    tool = _load_tool()
+    kind, payload = tool.load(str(tmp_path / "h.jsonl"))
+    assert kind == "metrics_jsonl" and len(payload) == 2
+    assert tool.main([str(tmp_path / "h.jsonl")]) == 0
+    kind, payload = tool.load(str(tmp_path / "h.prom"))
+    assert kind == "metrics_prom"
+    assert payload["dstpu_train_loss"] == 3.5
+
+
+def test_saturated_registry_rotates_instead_of_freezing(tmp_path):
+    # an always-on watch must keep seeing NEW spans and samples past the
+    # bounded registry's cap — saturation reclaims the drained buffers
+    reg = steptrace.MetricsRegistry(max_spans=8)
+    hw = HealthWatch({"enabled": True, "install_signal_handler": False},
+                     reg, source="train")
+    for i in range(5):
+        for _ in range(4):  # 4 spans/step > cap/steps: saturates fast
+            reg.begin("train/device", "train").end()
+        hw.on_step_start()
+        hw.on_train_step(step=i + 1, loss=2.0, grad_norm=1.0)
+    assert hw.rotations >= 1
+    # compute kept accruing across the rotation — nothing froze
+    assert hw.ring[-1]["spans"], "spans still drained after saturation"
+    assert hw.buckets["compute"] > 0
+    exp = MetricsExporter(str(tmp_path / "h.jsonl"), interval_s=0.0)
+    for i in range(20):
+        reg.sample("train/loss", float(i), step=i)
+        exp.flush(reg)
+    assert len(reg.samples) < reg.max_spans  # reclaimed, not frozen
+    rows = [json.loads(x) for x in open(tmp_path / "h.jsonl")]
+    assert rows[-1]["metrics"]["train/loss"] == 19.0  # latest, not stale
+
+
+def test_sigterm_chain_respects_sig_ign():
+    import signal
+
+    assert healthwatch._on_sigterm.__module__  # sanity: import surface
+    healthwatch._PREV_SIGTERM = signal.SIG_IGN
+    try:
+        # a process that deliberately ignored SIGTERM must keep ignoring
+        # it after the evidence dump — no SystemExit
+        healthwatch._on_sigterm(signal.SIGTERM, None)
+        with pytest.raises(SystemExit):
+            healthwatch._PREV_SIGTERM = signal.SIG_DFL
+            healthwatch._on_sigterm(signal.SIGTERM, None)
+    finally:
+        healthwatch._PREV_SIGTERM = None
+
+
+def test_exporter_interval_throttles(tmp_path):
+    clk = FakeClock()
+    exp = MetricsExporter(str(tmp_path / "h.jsonl"), interval_s=10.0,
+                          clock=clk)
+    assert exp.maybe_flush(None, extra={"a": 1.0})  # first always flushes
+    assert not exp.maybe_flush(None, extra={"a": 2.0})  # inside interval
+    clk.advance(11.0)
+    assert exp.maybe_flush(None, extra={"a": 3.0})
+    assert exp.flushes == 2
+
+
+# ---------------------------------------------------------------------------
+# postmortem handlers + validation gate
+# ---------------------------------------------------------------------------
+def test_sigterm_and_crash_dumps(tmp_path):
+    pm_path = str(tmp_path / "pm.json")
+    hw, clk = synthetic_hw(postmortem_path=pm_path)
+    hw.on_step_start()
+    clk.advance(0.1)
+    hw.on_train_step(step=1, loss=2.0, grad_norm=1.0)
+    healthwatch._dump_all("sigterm")
+    pm = json.load(open(pm_path))
+    assert pm["reason"] == "sigterm" and len(pm["steps"]) == 1
+    # the chained excepthook dumps with a crash reason, then delegates
+    healthwatch._excepthook(ValueError, ValueError("boom"), None)
+    pm = json.load(open(pm_path))
+    assert pm["reason"] == "crash:ValueError"
+    tool = _load_tool()
+    assert tool.validate_postmortem(pm) == []
+
+
+def test_validate_rejects_truncated_and_malformed(tmp_path):
+    tool = _load_tool()
+    fixture = os.path.join(REPO, "tests", "fixtures",
+                           "postmortem_truncated.json")
+    assert tool.main(["--validate", fixture]) == 1  # truncated: exit 1
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": "healthwatch.postmortem.v1",
+                               "reason": "explicit"}))
+    assert tool.main(["--validate", str(bad)]) == 1  # missing sections
+    # a watchdog reason without the substantiating anomaly/step fails
+    hw, clk = synthetic_hw()
+    pm = hw.postmortem("watchdog:nonfinite_loss")
+    problems = tool.validate_postmortem(pm)
+    assert any("nonfinite_loss" in p for p in problems)
+
+
+def test_raise_action_dumps_then_raises(tmp_path):
+    pm_path = str(tmp_path / "pm.json")
+    hw, clk = synthetic_hw(
+        postmortem_path=pm_path,
+        rules={"nonfinite_loss": {"action": "raise"}},
+    )
+    hw.on_step_start()
+    clk.advance(0.1)
+    with pytest.raises(healthwatch.HealthwatchAnomaly):
+        hw.on_train_step(step=1, loss=float("nan"), grad_norm=1.0)
+    assert os.path.exists(pm_path)  # evidence first, then the crash
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+def test_check_pair_is_the_one_drift_definition():
+    ok = drift.check_pair(1.0, 1.0, "v5e")
+    assert ok["ok"] and ok["ratio"] == 1.0
+    assert ok["band"] == (0.5, 2.0)
+    cpu = drift.check_pair(1.0, 20.0, "cpu")
+    assert cpu["ok"]                          # cpu band is [1/25, 25]
+    assert not drift.check_pair(1.0, 30.0, "cpu")["ok"]
+    # unmeasurable pairs are drifted-by-definition, never a crash
+    assert not drift.check_pair(1.0, 0.0, "v5e")["ok"]
+    assert drift.check_pair(1.0, None, "v5e")["ratio"] is None
+    # precomputed-ratio form (the ledger gate's path) agrees
+    assert drift.check_pair(None, None, "v5e", ratio=1.9)["ok"]
+    assert not drift.check_pair(None, None, "v5e", ratio=2.1)["ok"]
+    # drift.check() consults the same predicate: a ratio inside the
+    # band passes, outside fails
+    ok_, problems = drift.check([{"source": "t", "gen": "v5e",
+                                  "ratio": 1.9}])
+    assert ok_ and not problems
+    ok_, problems = drift.check([{"source": "t", "gen": "v5e",
+                                  "ratio": 2.1}])
+    assert not ok_ and "outside" in problems[0]
+
+
+def test_serving_metrics_empty_window_never_nan():
+    m = ServingMetrics(clock=lambda: 0.0)
+    snap = m.snapshot()
+    # no requests completed yet: every reported value is finite
+    assert all(math.isfinite(float(v)) for v in snap.values())
+    # integer counters keep their type (the snapshot JSON shape is
+    # stable: "submitted": 0, not 0.0)
+    assert isinstance(snap["submitted"], int)
+    assert isinstance(snap["queue_depth"], int)
+    assert isinstance(snap["slot_occupancy"], float)
+    assert "nan" not in m.summary().lower()
+    # the percentile helpers drop poisoned samples instead of
+    # propagating them
+    assert percentile([], 95) == 0.0
+    assert percentile([float("nan"), float("inf"), 1.0], 95) == 1.0
+    assert recent_percentile([], 95) is None
+    assert recent_percentile([float("nan")], 95) is None
+    assert recent_percentile([0.1] * 50 + [0.5], 95, window=4) == 0.5
+    # a NaN that sneaks into a sample list cannot reach the bridge
+    m.ttft_s.extend([float("nan"), 0.25])
+    snap = m.snapshot()
+    assert snap["ttft_p95_s"] == 0.25
+    events = []
+
+    class FakeMonitor:
+        def write_events(self, evs):
+            events.extend(evs)
+
+    m.write_to(FakeMonitor(), step=1)
+    assert events and all(math.isfinite(v) for _t, v, _s in events)
+
+
+def test_train_mfu_reaches_registry():
+    engine = tiny_engine(None, steptrace={"enabled": True},
+                         steps_per_print=1)
+    data = train_data()
+    for _ in range(4):
+        engine.train_batch(batch=data)
+    reg = steptrace.get_registry()
+    tags = {t for t, _v, _s, _t in reg.samples}
+    # MFU rides the train/* namespace next to loss (and, with
+    # healthwatch on, next to train/goodput) — one export
+    assert "train/loss" in tags
+    assert "train/mfu" in tags
+    mfu = [v for t, v, _s, _t in reg.samples if t == "train/mfu"]
+    assert all(0.0 <= v for v in mfu) and math.isfinite(mfu[-1])
+    engine.destroy()

@@ -149,7 +149,32 @@ def main(argv=None) -> int:
     ap.add_argument("--check-acceptance", action="store_true",
                     help="exit 1 unless acceptance rate > 0 and mean "
                          "accepted tokens/step > 1 (the spec CI gate)")
+    ap.add_argument("--healthwatch", action="store_true",
+                    help="enable healthwatch on the replay (goodput "
+                         "accounting + anomaly watchdogs + flight "
+                         "recorder; docs/observability.md)")
+    ap.add_argument("--hw-queue-depth", type=int, default=None,
+                    metavar="N",
+                    help="arm the queue_depth_breach watchdog at N "
+                         "(action=dump — the breach leaves a postmortem); "
+                         "implies --healthwatch")
+    ap.add_argument("--hw-ttft-p95", type=float, default=None,
+                    metavar="SECONDS",
+                    help="arm the ttft_breach watchdog at a recent-window "
+                         "p95 TTFT of SECONDS; implies --healthwatch")
+    ap.add_argument("--postmortem", metavar="PATH", default=None,
+                    help="flight-recorder postmortem target; dumped by a "
+                         "breaching watchdog, or explicitly at replay end "
+                         "if no watchdog fired (implies --healthwatch; "
+                         "validate with tools/healthwatch.py)")
+    ap.add_argument("--check-health", metavar="RULES", default=None,
+                    help="comma-separated health/* rule names that MUST "
+                         "have fired during the replay (the seeded-"
+                         "anomaly CI gate); exit 1 otherwise")
     args = ap.parse_args(argv)
+    if (args.hw_queue_depth is not None or args.hw_ttft_p95 is not None
+            or args.postmortem or args.check_health):
+        args.healthwatch = True
 
     import jax
     import jax.numpy as jnp
@@ -176,6 +201,23 @@ def main(argv=None) -> int:
     )
     clock = VirtualClock()
     logger = CommsLogger()
+    hw_section = None
+    if args.healthwatch:
+        rules = {}
+        if args.hw_queue_depth is not None:
+            rules["queue_depth_breach"] = {
+                "threshold": args.hw_queue_depth, "action": "dump",
+            }
+        if args.hw_ttft_p95 is not None:
+            rules["ttft_breach"] = {
+                "p95_s": args.hw_ttft_p95, "action": "dump",
+            }
+        hw_section = {
+            "enabled": True,
+            "rules": rules,
+            "postmortem_path": args.postmortem,
+            "install_signal_handler": False,  # replay tool, not a prod run
+        }
     srv = ServingEngine(
         engine=engine,
         clock=clock,
@@ -185,6 +227,7 @@ def main(argv=None) -> int:
             {"enabled": True, "export_path": args.trace}
             if args.trace else None
         ),
+        healthwatch=hw_section,
         serving={
             "max_slots": args.slots,
             "token_budget": args.token_budget,
@@ -202,8 +245,9 @@ def main(argv=None) -> int:
             },
         },
     )
-    if args.trace:
+    if srv.tracer is not None:
         # the comms logger's stream records land on the same timeline
+        # (steptrace --trace or healthwatch both configure the registry)
         logger.registry = srv.tracer
     trace = build_trace(args)
     pending = list(trace)
@@ -264,6 +308,29 @@ def main(argv=None) -> int:
         out = srv.trace_export(args.trace)
         print(f"steptrace: wrote {out} "
               f"(validate/report with tools/trace_report.py)")
+    if srv.healthwatch is not None:
+        hw = srv.healthwatch
+        g = hw.goodput()
+        fired = sorted(hw.counters)
+        print(
+            f"healthwatch: goodput {g['goodput_fraction']:.3f}, fired "
+            f"rules: {', '.join(fired) if fired else 'none'}"
+        )
+        if args.postmortem and hw.dump_count == 0:
+            # no watchdog dumped — leave the end-of-replay evidence
+            hw.dump_postmortem(path=args.postmortem, reason="explicit")
+        if hw.last_postmortem:
+            print(f"healthwatch: postmortem -> {hw.last_postmortem} "
+                  f"(validate with tools/healthwatch.py)")
+    if args.check_health:
+        counters = (srv.healthwatch.counters
+                    if srv.healthwatch is not None else {})
+        missing = [r for r in args.check_health.split(",")
+                   if r and r not in counters]
+        if missing:
+            print(f"ERROR: expected health rule(s) never fired: "
+                  f"{', '.join(missing)}")
+            return 1
     if m["finished"] != args.requests:
         print(f"ERROR: {args.requests - m['finished']} requests unfinished")
         return 1

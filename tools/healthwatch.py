@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""healthwatch: render + validate healthwatch postmortems and metrics
+exports.
+
+    python tools/healthwatch.py postmortem.json      # verdict tables:
+                                                     # goodput buckets,
+                                                     # anomalies, last-K
+                                                     # steps, drift state
+    python tools/healthwatch.py --validate pm.json   # schema gate: exit 1
+                                                     # on a malformed /
+                                                     # truncated postmortem
+    python tools/healthwatch.py health.jsonl         # latest-metrics table
+                                                     # from a JSON-lines
+                                                     # export (health.prom
+                                                     # renders too)
+
+Reads the artifacts written by ``profiling/healthwatch.py``
+(docs/observability.md "healthwatch"): the flight-recorder postmortem
+(``engine.dump_postmortem`` / a watchdog's ``dump`` action / SIGTERM /
+crash) and the interval-flushed metrics export. Pure stdlib on purpose —
+postmortems get inspected on whatever machine the JSON landed on, no
+jax required.
+
+The ``--validate`` contract (the CI gate in ci.yml):
+
+- the file parses as JSON and carries ``schema ==
+  "healthwatch.postmortem.v1"``;
+- required top-level keys exist with the right shapes (``reason`` /
+  ``source`` strings, numeric ``created_ts``/``elapsed_s``);
+- ``goodput`` has numeric, non-negative buckets and a
+  ``goodput_fraction`` in [0, 1];
+- ``steps`` is the flight-recorder ring: every record carries a step
+  number, a numeric ``step_s``, a ``spans`` list and a ``watchdog``
+  evaluation list;
+- ``anomalies`` entries are well-formed (rule/severity/action/step);
+- a ``watchdog:<rule>`` reason must be substantiated: the named rule
+  appears in ``anomalies``, its firing step is present in the ring, and
+  that triggering step's record contains at least one span — a
+  postmortem that cannot show the step that tripped it is not evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+SCHEMA = "healthwatch.postmortem.v1"
+BUCKETS = ("compute", "compile", "stall_on_data", "checkpoint",
+           "comm_exposed", "idle")
+SEVERITIES = ("info", "warn", "critical")
+ACTIONS = ("log", "dump", "raise")
+
+
+# ------------------------------------------------------------- loading
+def load(path: str):
+    """(kind, payload): kind is "postmortem", "metrics_jsonl" or
+    "metrics_prom". Raises ValueError on unreadable/undecodable input."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if not stripped:
+        raise ValueError("empty file")
+    if path.endswith(".prom") or (not stripped.startswith("{")
+                                  and not stripped.startswith("[")):
+        metrics: Dict[str, float] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"malformed prom line: {line!r}")
+            metrics[parts[0]] = float(parts[1])
+        return "metrics_prom", metrics
+    # one JSON object => postmortem; several lines of objects => jsonl
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if len(lines) > 1:
+        try:
+            rows = [json.loads(ln) for ln in lines]
+            if all(isinstance(r, dict) and "metrics" in r for r in rows):
+                return "metrics_jsonl", rows
+        except ValueError:
+            pass  # fall through to whole-file parse (pretty-printed pm)
+    data = json.loads(text)
+    if isinstance(data, dict) and "metrics" in data and "schema" not in data:
+        return "metrics_jsonl", [data]
+    return "postmortem", data
+
+
+# ---------------------------------------------------------- validation
+def validate_postmortem(pm: Any) -> List[str]:
+    problems: List[str] = []
+    if not isinstance(pm, dict):
+        return [f"postmortem is not a JSON object ({type(pm).__name__})"]
+    if pm.get("schema") != SCHEMA:
+        problems.append(
+            f"schema is {pm.get('schema')!r}, expected {SCHEMA!r}"
+        )
+    for key, ty in (("reason", str), ("source", str)):
+        if not isinstance(pm.get(key), ty):
+            problems.append(f"missing/invalid {key!r}")
+    for key in ("created_ts", "elapsed_s"):
+        if not isinstance(pm.get(key), (int, float)):
+            problems.append(f"missing/non-numeric {key!r}")
+
+    g = pm.get("goodput")
+    if not isinstance(g, dict):
+        problems.append("missing goodput section")
+    else:
+        buckets = g.get("buckets")
+        if not isinstance(buckets, dict):
+            problems.append("goodput.buckets missing")
+        else:
+            for b in BUCKETS:
+                v = buckets.get(b)
+                if not isinstance(v, (int, float)) or v < 0:
+                    problems.append(
+                        f"goodput bucket {b!r} missing/negative ({v!r})"
+                    )
+        frac = g.get("goodput_fraction")
+        if not isinstance(frac, (int, float)) or not 0.0 <= frac <= 1.0:
+            problems.append(
+                f"goodput_fraction {frac!r} not in [0, 1]"
+            )
+
+    steps = pm.get("steps")
+    if not isinstance(steps, list):
+        problems.append("steps (flight-recorder ring) missing")
+        steps = []
+    for i, rec in enumerate(steps):
+        if not isinstance(rec, dict):
+            problems.append(f"steps[{i}]: not an object")
+            continue
+        if not isinstance(rec.get("step"), int):
+            problems.append(f"steps[{i}]: missing step number")
+        if not isinstance(rec.get("step_s"), (int, float)):
+            problems.append(f"steps[{i}]: missing step_s")
+        if not isinstance(rec.get("spans"), list):
+            problems.append(f"steps[{i}]: missing spans list")
+        if not isinstance(rec.get("watchdog"), list):
+            problems.append(f"steps[{i}]: missing watchdog evaluations")
+
+    anomalies = pm.get("anomalies")
+    if not isinstance(anomalies, list):
+        problems.append("anomalies list missing")
+        anomalies = []
+    for i, ev in enumerate(anomalies):
+        if not isinstance(ev, dict):
+            problems.append(f"anomalies[{i}]: not an object")
+            continue
+        if not isinstance(ev.get("rule"), str):
+            problems.append(f"anomalies[{i}]: missing rule")
+        if ev.get("severity") not in SEVERITIES:
+            problems.append(
+                f"anomalies[{i}]: bad severity {ev.get('severity')!r}"
+            )
+        if ev.get("action") not in ACTIONS:
+            problems.append(
+                f"anomalies[{i}]: bad action {ev.get('action')!r}"
+            )
+        if not isinstance(ev.get("step"), int):
+            problems.append(f"anomalies[{i}]: missing step")
+
+    reason = pm.get("reason")
+    if isinstance(reason, str) and reason.startswith("watchdog:"):
+        rule = reason.split(":", 1)[1]
+        firing = [
+            ev for ev in anomalies
+            if isinstance(ev, dict) and ev.get("rule") == rule
+        ]
+        if not firing:
+            problems.append(
+                f"reason {reason!r} but no {rule!r} anomaly recorded"
+            )
+        else:
+            by_step = {
+                rec.get("step"): rec for rec in steps
+                if isinstance(rec, dict)
+            }
+            trig = by_step.get(firing[-1].get("step"))
+            if trig is None:
+                problems.append(
+                    f"triggering step {firing[-1].get('step')} of "
+                    f"{rule!r} is not in the flight-recorder ring"
+                )
+            elif not trig.get("spans"):
+                problems.append(
+                    f"triggering step {firing[-1].get('step')} of "
+                    f"{rule!r} carries no spans — the postmortem cannot "
+                    "show the step that tripped it"
+                )
+    return problems
+
+
+# ------------------------------------------------------------ reporting
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows)
+        for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*header)]
+    out += [fmt.format(*[str(c) for c in r]) for r in rows]
+    return "\n".join(out)
+
+
+def report_postmortem(pm: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    lines.append(
+        f"healthwatch postmortem — source={pm.get('source')} "
+        f"reason={pm.get('reason')} elapsed={pm.get('elapsed_s', 0):.3f}s"
+    )
+    g = pm.get("goodput") or {}
+    buckets = g.get("buckets") or {}
+    el = max(float(g.get("elapsed_s", 0) or 0), 1e-12)
+    lines.append("")
+    lines.append(f"goodput fraction: {g.get('goodput_fraction', 0):.4f}")
+    lines.append(_table(
+        [[b, f"{float(buckets.get(b, 0)):.4f}",
+          f"{100.0 * float(buckets.get(b, 0)) / el:.1f}%"]
+         for b in BUCKETS],
+        ["bucket", "seconds", "% elapsed"],
+    ))
+    anomalies = pm.get("anomalies") or []
+    lines.append("")
+    if anomalies:
+        lines.append(f"anomalies ({len(anomalies)}):")
+        lines.append(_table(
+            [[ev.get("step"), ev.get("rule"), ev.get("severity"),
+              ev.get("action"), ev.get("value"),
+              (ev.get("detail") or "")[:60]]
+             for ev in anomalies],
+            ["step", "rule", "severity", "action", "value", "detail"],
+        ))
+    else:
+        lines.append("anomalies: none")
+    drift = pm.get("drift") or {}
+    if drift.get("predicted_step_s") is not None:
+        last = drift.get("last") or {}
+        lines.append("")
+        lines.append(
+            f"drift: predicted {drift['predicted_step_s']}s/step "
+            f"(gen {drift.get('gen')}), last verdict "
+            f"ok={last.get('ok')} ratio={last.get('ratio')} "
+            f"band={last.get('band')}"
+        )
+    steps = pm.get("steps") or []
+    lines.append("")
+    lines.append(f"flight recorder (last {len(steps)} steps):")
+    rows = []
+    for rec in steps[-16:]:
+        fired = [w["rule"] for w in rec.get("watchdog", [])
+                 if isinstance(w, dict) and w.get("fired")]
+        rows.append([
+            rec.get("step"), f"{float(rec.get('step_s', 0)):.4f}",
+            rec.get("loss") if rec.get("loss") is not None
+            else rec.get("queue_depth", ""),
+            rec.get("compiled", 0),
+            len(rec.get("spans", [])),
+            ",".join(fired) or "-",
+        ])
+    lines.append(_table(
+        rows, ["step", "step_s", "loss/queue", "compiled", "spans",
+               "fired"],
+    ))
+    counters = pm.get("counters") or {}
+    if counters:
+        lines.append("")
+        lines.append("rule counters: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(counters.items())
+        ))
+    return "\n".join(lines)
+
+
+def report_metrics(kind: str, payload) -> str:
+    if kind == "metrics_prom":
+        rows = sorted(payload.items())
+        return _table([[k, f"{v:.6g}"] for k, v in rows],
+                      ["metric", "value"])
+    latest: Dict[str, float] = {}
+    steps: Dict[str, Any] = {}
+    for row in payload:
+        latest.update(row.get("metrics") or {})
+        steps.update(row.get("steps") or {})
+    return _table(
+        [[k, f"{float(v):.6g}", steps.get(k, "")]
+         for k, v in sorted(latest.items())],
+        ["metric", "latest", "step"],
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="healthwatch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("path", help="postmortem JSON, metrics JSONL, or "
+                                 ".prom textfile")
+    ap.add_argument("--validate", action="store_true",
+                    help="postmortem schema gate: exit 1 on violation")
+    args = ap.parse_args(argv)
+
+    try:
+        kind, payload = load(args.path)
+    except (OSError, ValueError) as e:
+        print(f"healthwatch: cannot load {args.path}: {e}",
+              file=sys.stderr)
+        return 1
+
+    if args.validate:
+        if kind != "postmortem":
+            print(f"healthwatch: {args.path} is a {kind} file, not a "
+                  "postmortem — nothing to validate", file=sys.stderr)
+            return 1
+        problems = validate_postmortem(payload)
+        if problems:
+            print(f"healthwatch: {len(problems)} violation(s) in "
+                  f"{args.path}:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print(
+            f"healthwatch: {args.path} OK — reason="
+            f"{payload.get('reason')}, {len(payload.get('steps', []))} "
+            f"ring step(s), {len(payload.get('anomalies', []))} "
+            f"anomaly(ies)"
+        )
+        return 0
+
+    if kind == "postmortem":
+        print(report_postmortem(payload))
+    else:
+        print(report_metrics(kind, payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
