@@ -234,6 +234,70 @@ def run_moe_a2a_ab():
     )
 
 
+def qgz_ab_mode() -> bool:
+    """BENCH_QGZ_AB=1 → CPU-mesh A/B of the wire-codec ZeRO collectives
+    (zero_optimization.grad_wire / param_wire — comm/wires.py qgZ/qwZ)."""
+    return _force_cpu_mesh_mode("BENCH_QGZ_AB")
+
+
+def run_qgz_ab():
+    """Full-width (fp32 wires) vs quantized (int8 grad + param wires)
+    stage-3 step on the CPU mesh — serial-vs-quantized validation A/B
+    printing ONE JSON line with both step times, the analytic wire
+    MiB/step (grad_wire + param_wire + codec-priced zero3_prefetch
+    streams) and the LOSS DELTA vs the full-width leg after the timed
+    steps (the codec's end-to-end error evidence; bounds are
+    property-tested per codec in tests/test_wires.py). CPU step times
+    say nothing about ICI, so the knobs stay default-off and no perf
+    record is banked; the on-chip recipe is docs/wires.md."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.models import llama
+
+    B, S = 8, 128
+    model = llama(
+        "llama-tiny", vocab_size=512, max_seq_len=S, hidden_size=128,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+        intermediate_size=512,
+    )
+    data = {
+        "input_ids": np.random.RandomState(0).randint(0, 512, size=(B, S))
+    }
+
+    def leg(grad_wire, param_wire):
+        comm.destroy_process_group()
+        zero = {"stage": 3, "stage3_param_persistence_threshold": 1000,
+                "grad_wire": grad_wire, "param_wire": param_wire}
+        cfg = make_ds_config(B, zero, "none", 1, {})
+        cfg["comms_logger"] = {"enabled": True}
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        dt = _timed_leg(engine, data)
+        loss = float(engine.train_batch(batch=data))
+        streams = engine.analytic_streams()
+        wire_bytes = sum(
+            streams[k]["bytes_per_step"]
+            for k in ("grad_wire", "param_wire", "zero3_prefetch")
+            if k in streams
+        )
+        engine.destroy()
+        return dt, loss, wire_bytes
+
+    dt_serial, loss_full, _ = leg("fp32", "fp32")
+    dt_q, loss_q, wire_bytes = leg("int8", "int8")
+    _ab_result(
+        "qgZ/qwZ wire A/B (CPU-mesh validation, not a perf record; "
+        "knobs default-off pending on-chip A/B)",
+        dt_serial, dt_q, wire_bytes,
+        extra={
+            "loss_fullwidth": round(loss_full, 6),
+            "loss_quantized": round(loss_q, 6),
+            "loss_delta_rel": round(
+                abs(loss_q - loss_full) / max(abs(loss_full), 1e-9), 6
+            ),
+        },
+    )
+
+
 def run_z3_prefetch_ab():
     """Plain stage 3 (all-gather-on-use) vs one-layer-ahead prefetch on
     the CPU mesh — same validation protocol as run_moe_a2a_ab."""
@@ -691,6 +755,8 @@ def main():
         return run_moe_a2a_ab()
     if z3_prefetch_ab_mode():
         return run_z3_prefetch_ab()
+    if qgz_ab_mode():
+        return run_qgz_ab()
     smoke = smoke_mode()
     enable_compile_cache()
     import deepspeed_tpu

@@ -24,6 +24,11 @@ Candidate axes:
   layer-prefetch on/off on stage-3 rungs (ISSUE 10): both priced through
   the same R6/R8 static gate BEFORE any compile — R8 rejects a rung
   whose declared-overlapped stream cannot hide in the compute window;
+- the wire-codec axis (ISSUE 12, comm/wires.py): stage x grad_wire x
+  param_wire — grad-reduce-scatter codecs on stage>=1 rungs, stage-3
+  param-gather codecs on stage-3 rungs, each candidate's analytic
+  grad_wire/param_wire streams priced statically (``wire_codecs``
+  constructor arg; ("fp32",) collapses the axis);
 - serving ``token_budget`` for serving-enabled configs (the slot step
   is traced through ``lint_serving_config`` instead of a train step);
 - mesh shape (dp×tp factorizations) for capacity dryruns — CLI-only,
@@ -74,6 +79,8 @@ class Candidate:
     tp_overlap: Optional[bool] = None
     moe_a2a: Optional[bool] = None       # decomposed MoE a2a on/off
     z3_prefetch: Optional[bool] = None   # stage-3 layer prefetch on/off
+    grad_wire: Optional[str] = None      # grad RS codec (stage >= 1 rungs)
+    param_wire: Optional[str] = None     # stage-3 param gather codec
     token_budget: Optional[int] = None
     mesh: Optional[Tuple[int, int]] = None  # (dp, tp)
 
@@ -90,8 +97,8 @@ class Candidate:
         """Everything but micro — the memoization group whose plans
         scale batch-linearly."""
         return (self.zero, self.remat, self.flash_blocks, self.tp_overlap,
-                self.moe_a2a, self.z3_prefetch, self.token_budget,
-                self.mesh)
+                self.moe_a2a, self.z3_prefetch, self.grad_wire,
+                self.param_wire, self.token_budget, self.mesh)
 
     def label(self) -> str:
         z = self.zero_dict
@@ -108,6 +115,10 @@ class Candidate:
             parts.append("a2aov" if self.moe_a2a else "a2aser")
         if self.z3_prefetch is not None:
             parts.append("z3pf" if self.z3_prefetch else "z3ser")
+        if self.grad_wire is not None and self.grad_wire != "fp32":
+            parts.append(f"gw-{self.grad_wire}")
+        if self.param_wire is not None and self.param_wire != "fp32":
+            parts.append(f"pw-{self.param_wire}")
         if self.token_budget is not None:
             parts = [f"serve-tb{self.token_budget}"]
         if self.mesh is not None:
@@ -241,6 +252,7 @@ class PlannerSearch:
                  mesh_shapes: Optional[Sequence[Tuple[int, int]]] = None,
                  token_budgets: Sequence[int] = DEFAULT_TOKEN_BUDGETS,
                  include_tiles: bool = False,
+                 wire_codecs: Sequence[str] = ("fp32", "int8"),
                  tuner=None):
         from .autotuner import Autotuner
 
@@ -252,6 +264,10 @@ class PlannerSearch:
         self.mesh_shapes = list(mesh_shapes or [])
         self.token_budgets = tuple(token_budgets)
         self.include_tiles = include_tiles
+        # the wire-codec axis (ISSUE 12, comm/wires.py): grad_wire on
+        # stage>=1 rungs, param_wire on stage-3 rungs — every combination
+        # priced statically before any compile. ("fp32",) collapses it.
+        self.wire_codecs = tuple(wire_codecs)
         self.tuner = tuner or Autotuner(
             model, base_config, topology=topology, sample_batch_fn=None
         )
@@ -316,18 +332,58 @@ class PlannerSearch:
                 z3_axis: List[Optional[bool]] = (
                     [False, True] if int(stage) == 3 else [None]
                 )
+                # wire-codec axis (stage x grad_wire x param_wire): the
+                # grad reduce-scatter codec exists from stage 1, the
+                # param gather codec only at stage 3. Rungs where the
+                # engine's wired reduction is a KNOWN no-op from the
+                # base config (pipeline parallelism, the 1-bit wire
+                # optimizer, a mesh with no >1-size data axis) skip the
+                # grad axis — enumerating it would trace duplicate
+                # identical plans (group_key differs, memoization
+                # cannot collapse them)
+                wires = self.wire_codecs
+                opt_name = (ds.optimizer.type or "").lower().replace(
+                    "_", ""
+                )
+                data_live = self.topology is None or any(
+                    self.topology.sizes[a] > 1 for a in ("dp", "fsdp")
+                )
+                gw_ok = (
+                    int(ds.pipeline.stages) <= 1
+                    and opt_name not in ("onebitadam", "onebitlamb")
+                    and data_live
+                )
+                gw_axis: List[Optional[str]] = (
+                    list(wires)
+                    if int(stage) >= 1 and len(wires) > 1 and gw_ok
+                    else [None]
+                )
+                pw_axis: List[Optional[str]] = (
+                    list(wires)
+                    if int(stage) == 3 and len(wires) > 1 and data_live
+                    else [None]
+                )
                 for pol in REMAT_POLICIES:
                     for mb in mbs:
                         for ov in overlap_axis:
                             for a2a in a2a_axis:
                                 for z3 in z3_axis:
-                                    for blocks in tiles:
-                                        out.append(Candidate(
-                                            zero=zero, remat=pol, micro=mb,
-                                            flash_blocks=tuple(blocks),
-                                            tp_overlap=ov, moe_a2a=a2a,
-                                            z3_prefetch=z3, mesh=mesh,
-                                        ))
+                                    for gw in gw_axis:
+                                        for pw in pw_axis:
+                                            for blocks in tiles:
+                                                out.append(Candidate(
+                                                    zero=zero, remat=pol,
+                                                    micro=mb,
+                                                    flash_blocks=tuple(
+                                                        blocks
+                                                    ),
+                                                    tp_overlap=ov,
+                                                    moe_a2a=a2a,
+                                                    z3_prefetch=z3,
+                                                    grad_wire=gw,
+                                                    param_wire=pw,
+                                                    mesh=mesh,
+                                                ))
         return out
 
     # ----------------------------------------------------------------- plan
@@ -355,6 +411,14 @@ class PlannerSearch:
         if cand.z3_prefetch is not None:
             zo = dict(cfg.get("zero_optimization") or {})
             zo["stage3_layer_prefetch"] = bool(cand.z3_prefetch)
+            cfg["zero_optimization"] = zo
+        if cand.grad_wire is not None:
+            zo = dict(cfg.get("zero_optimization") or {})
+            zo["grad_wire"] = cand.grad_wire
+            cfg["zero_optimization"] = zo
+        if cand.param_wire is not None:
+            zo = dict(cfg.get("zero_optimization") or {})
+            zo["param_wire"] = cand.param_wire
             cfg["zero_optimization"] = zo
         if cand.token_budget is not None:
             sv = dict(cfg.get("serving") or {})

@@ -17,7 +17,7 @@ from typing import Optional
 import jax
 
 from ..utils.logging import log_dist, logger
-from . import collectives
+from . import collectives, wires  # noqa: F401  (wires: codec comm layer)
 from .collectives import (  # noqa: F401  (re-export op surface)
     all_gather,
     all_reduce,

@@ -149,6 +149,35 @@ class ZeroConfig:
     # MiCS-style sub-partitioning
     mics_shard_size: int = -1
     mics_hierarchical_params_gather: bool = False
+    # ---- wire codecs (comm/wires.py, docs/wires.md) ----------------------
+    # grad_wire: codec for the ZeRO gradient reduce-scatter on the data
+    # axes (qgZ — blocks quantize ONCE before the exchange, the
+    # accumulate runs after dequant in f32 master precision). Applies at
+    # stages 1/2 (explicit wire reduction replaces the GSPMD-implicit
+    # one) and stage 3 (the gather's backward). "auto" resolves from the
+    # legacy bool: int8 when zero_quantized_gradients, else fp32.
+    grad_wire: str = "auto"   # auto | fp32 | bf16 | int8 | int4
+    # param_wire: codec for the stage-3 parameter all-gathers (qwZ),
+    # composing with stage3_layer_prefetch (the prefetched gather then
+    # moves codec bytes). "auto": int8 when zero_quantized_weights.
+    param_wire: str = "auto"  # auto | fp32 | bf16 | int8 | int4
+    # hierarchical_wire: 2-hop collectives over a factored (dp, fsdp)
+    # mesh — intra-group (fsdp) hops run full width on the fast links,
+    # inter-group (dp) hops move codec bytes (ZeRO++ hgZ / EQuARX).
+    # Ignored (with a log line) when dp or fsdp is not live.
+    hierarchical_wire: bool = False
+
+    _WIRE_CODECS = ("auto", "fp32", "bf16", "int8", "int4")
+
+    def resolved_grad_wire(self) -> str:
+        if self.grad_wire != "auto":
+            return self.grad_wire
+        return "int8" if self.zero_quantized_gradients else "fp32"
+
+    def resolved_param_wire(self) -> str:
+        if self.param_wire != "auto":
+            return self.param_wire
+        return "int8" if self.zero_quantized_weights else "fp32"
 
     def validate(self) -> None:
         if self.stage not in (0, 1, 2, 3):
@@ -165,6 +194,30 @@ class ZeroConfig:
         ):
             raise DeepSpeedConfigError(
                 "zero_quantized_weights/gradients (ZeRO++) require stage 3"
+            )
+        for knob in ("grad_wire", "param_wire"):
+            v = getattr(self, knob)
+            if v not in self._WIRE_CODECS:
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{knob} must be one of "
+                    f"{self._WIRE_CODECS}, got {v!r}"
+                )
+        if self.resolved_grad_wire() != "fp32" and self.stage < 1:
+            raise DeepSpeedConfigError(
+                "zero_optimization.grad_wire requires ZeRO stage >= 1 "
+                "(stage 0 has no data-axis gradient reduce-scatter to "
+                "compress — the DDP psum stays full width)"
+            )
+        if self.resolved_param_wire() != "fp32" and self.stage != 3:
+            raise DeepSpeedConfigError(
+                "zero_optimization.param_wire requires ZeRO stage 3 "
+                "(below it parameters are never gathered over a wire)"
+            )
+        if self.hierarchical_wire and self.stage < 1:
+            raise DeepSpeedConfigError(
+                "zero_optimization.hierarchical_wire requires ZeRO stage "
+                ">= 1 (stage 0 has no data-axis wire collectives to run "
+                "the 2-hop forms over)"
             )
 
 

@@ -143,24 +143,24 @@ def _hop(x, axis, perm):
     return collectives.permute(x, axis, perm)
 
 
-def _quantize_wire(x2d: jax.Array):
-    """int8 + per-lane fp32 scale wire format (ZeRO++ qwZ): symmetric
-    lanewise quantization over the row axis."""
-    from ..runtime.zero.quantized import _quantize_lanewise
-
-    return _quantize_lanewise(x2d)
-
-
 def _q(x: jax.Array):
     """Quantize an arbitrary-rank wire payload: lanes are the trailing dim,
-    everything else flattens into the quantized (row) axis."""
-    q, scale = _quantize_wire(x.reshape((-1, x.shape[-1])))
+    everything else flattens into the quantized (row) axis. ONE shared
+    implementation — the int8 codec of comm/wires.py (bitwise identical
+    to the pre-wires private ``_quantize_lanewise``)."""
+    from ..comm.wires import quantize_lanewise
+
+    q, scale = quantize_lanewise(x.reshape((-1, x.shape[-1])))
     return q.reshape(x.shape), scale
 
 
 def _dq(q: jax.Array, scale: jax.Array, dtype):
-    flat = q.reshape((-1, q.shape[-1])).astype(jnp.float32) * scale
-    return flat.reshape(q.shape).astype(dtype)
+    from ..comm.wires import dequantize_lanewise
+
+    flat = dequantize_lanewise(
+        q.reshape((-1, q.shape[-1])), scale, dtype
+    )
+    return flat.reshape(q.shape)
 
 
 def _row_chunks(rows: int, chunks: int) -> List[Tuple[int, int]]:

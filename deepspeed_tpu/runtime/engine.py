@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -504,10 +505,29 @@ class TpuEngine:
         self.param_specs, self.grad_specs, self.opt_leaf_specs = zero_specs(
             params_shape, tp_specs, topology, config.zero_config
         )
+        self._tp_specs = tp_specs
+        self._params_shape = params_shape
         # ---- ZeRO-3 one-layer-ahead parameter prefetch
         # (zero_optimization.stage3_layer_prefetch: runtime/zero/prefetch.py).
         # The puts tree is one layer slice's gathered (tp-only) shardings;
         # persistence-threshold leaves come back as identity puts. --------
+        # ---- wire codecs (comm/wires.py, docs/wires.md): the grad
+        # reduce-scatter / param all-gather wire formats. Legacy
+        # zero_quantized_* bools resolve to int8 codecs. ----------------
+        zc = config.zero_config
+        self._grad_wire = zc.resolved_grad_wire()
+        self._param_wire = zc.resolved_param_wire()
+        self._hier_wire = bool(zc.hierarchical_wire)
+        if self._hier_wire and not (
+            topology.sizes["dp"] > 1 and topology.sizes["fsdp"] > 1
+        ):
+            log_dist(
+                "zero_optimization.hierarchical_wire: needs a live "
+                f"factored dp x fsdp mesh (this one is {topology}); the "
+                "2-hop forms have no groups to split — knob ignored, "
+                "single-hop wires run"
+            )
+            self._hier_wire = False
         self._z3_prefetch_puts = None
         self._z3_prefetch_shapes = None
         if config.zero_config.stage3_layer_prefetch:
@@ -521,7 +541,10 @@ class TpuEngine:
                 from .zero.prefetch import build_layer_puts
 
                 self._z3_prefetch_puts = build_layer_puts(
-                    params_shape, tp_specs, self.param_specs, topology
+                    params_shape, tp_specs, self.param_specs, topology,
+                    param_wire=self._param_wire,
+                    grad_wire=self._grad_wire,
+                    hierarchical=self._hier_wire,
                 )
                 if self._z3_prefetch_puts is None:
                     log_dist(
@@ -532,11 +555,17 @@ class TpuEngine:
                 else:
                     self._z3_prefetch_shapes = (params_shape, tp_specs)
         self._qgather = None
-        zc = config.zero_config
-        if zc.zero_quantized_weights or zc.zero_quantized_gradients:
-            # ZeRO++ qwZ/qgZ: explicit quantized gather replaces XLA's
-            # implicit one; its custom backward is the (quantized) grad
-            # reduce-scatter (runtime/zero/quantized.py)
+        if zc.stage == 3 and (
+            self._param_wire != "fp32"
+            or self._grad_wire != "fp32"
+            or self._hier_wire
+        ):
+            # ZeRO++ qwZ/qgZ/hgZ: explicit wire-codec gather replaces
+            # XLA's implicit one; its custom backward is the codec grad
+            # reduce-scatter (runtime/zero/quantized.py). When the layer
+            # prefetch owns the stacked group's gathers, exclude it here
+            # — its WirePut callables run the same per-leaf program
+            # inside the scan (runtime/zero/prefetch.py).
             from .zero.quantized import make_quantized_gather
 
             self._qgather = make_quantized_gather(
@@ -544,9 +573,53 @@ class TpuEngine:
                 self.param_specs,
                 tp_specs,
                 params_shape,
-                zc.zero_quantized_weights,
-                zc.zero_quantized_gradients,
+                param_wire=self._param_wire,
+                grad_wire=self._grad_wire,
+                hierarchical=self._hier_wire,
+                exclude_key=(
+                    "layers" if self._z3_prefetch_puts is not None else None
+                ),
             )
+        # stage-1/2 grad wire (qgZ at the dp reduction itself): the grad
+        # computation runs per data-shard inside a shard_map and the
+        # cross-member reduction becomes the explicit codec
+        # reduce-scatter (stage 3's grad wire rides the gather's custom
+        # backward instead — see the _qgather block above)
+        self._wired_grad_axes = None
+        # the wired reduction also engages for fp32 + hierarchical_wire:
+        # the 2-hop topology win (only 1/n_fsdp of the bytes cross the
+        # slow dp links) exists without any quantization
+        _wire_wanted = self._grad_wire != "fp32" or self._hier_wire
+        if _wire_wanted and zc.stage in (1, 2) and (
+            config.pipeline.stages > 1
+            or getattr(model, "is_pipeline_module", False)
+            or self._stacked_grads_axes is not None
+        ):
+            log_dist(
+                "zero_optimization.grad_wire: the wired reduction cannot "
+                "run under pipeline parallelism / the 1-bit wire path; "
+                "the full-width reduction runs"
+            )
+        elif _wire_wanted and zc.stage in (1, 2):
+            if not data_axes_live:
+                log_dist(
+                    "zero_optimization.grad_wire: no >1-size data axis on "
+                    "this mesh — nothing to compress, the full-width "
+                    "reduction runs"
+                )
+            elif not wire_shardable:
+                log_dist(
+                    "zero_optimization.grad_wire: legacy jax cannot "
+                    "compile the partial-manual wire shard_map beside "
+                    "other live mesh axes; the full-width reduction runs"
+                )
+            else:
+                self._wired_grad_axes = data_axes_live
+                log_dist(
+                    f"grad wire active: {self._grad_wire} reduce-scatter "
+                    f"over {data_axes_live}"
+                    + (" (hierarchical 2-hop)" if self._hier_wire else "")
+                )
         # ---- offload (reference: zero offload_optimizer / offload_param +
         # swap_tensor/partitioned_optimizer_swapper) --------------------------
         off_opt = zc.offload_optimizer
@@ -757,6 +830,8 @@ class TpuEngine:
         self._moe_a2a_streams = {}
         self.moe_a2a_stream = self._compute_moe_a2a_stream()
         self.z3_prefetch_stream = self._compute_z3_prefetch_stream()
+        self.grad_wire_stream = self._compute_grad_wire_stream()
+        self.param_wire_stream = self._compute_param_wire_stream()
         if config.healthwatch.enabled and not self.abstract:
             self._build_healthwatch(config.healthwatch)
         if self._nvme_swapper is not None and not self.abstract:
@@ -936,6 +1011,30 @@ class TpuEngine:
                 "per_device_bytes_per_step": z3["bytes_per_step"],
                 "overlapped": True,
             }
+        # wire-codec streams (comm/wires.py): the grad reduce-scatter and
+        # stage-3 param gathers in codec bytes. Declared NOT overlapped —
+        # they are serial collectives (the win is fewer bytes, not hidden
+        # ones), except where the prefetch already owns (and overlaps)
+        # the stacked layers' share via zero3_prefetch above. shardplan
+        # prices them; R8 sees the codec-shrunk zero3_prefetch stream.
+        gw = self.grad_wire_stream
+        if gw:
+            streams["grad_wire"] = {
+                **gw,
+                "kind": "ici",
+                "bytes_per_step": gw["bytes_per_step"],
+                "per_device_bytes_per_step": gw["bytes_per_step"],
+                "overlapped": False,
+            }
+        pw = self.param_wire_stream
+        if pw:
+            streams["param_wire"] = {
+                **pw,
+                "kind": "ici",
+                "bytes_per_step": pw["bytes_per_step"],
+                "per_device_bytes_per_step": pw["bytes_per_step"],
+                "overlapped": False,
+            }
         return streams
 
     def _record_offload_stream(self, steps: int = 1, batch=None):
@@ -1040,7 +1139,9 @@ class TpuEngine:
     def _compute_z3_prefetch_stream(self):
         """Static per-step all-gather wire for the prefetched layer scan
         (None when the knob/mesh leaves nothing to prefetch). Shapes, not
-        batch, set this stream — no per-seq cache needed."""
+        batch, set this stream — no per-seq cache needed. Wire codecs
+        shrink it: with ``param_wire`` / ``grad_wire`` set the prefetched
+        gather moves codec bytes and R8 prices the smaller stream."""
         if self._z3_prefetch_puts is None:
             return None
         from .zero.prefetch import prefetch_wire_bytes_per_step
@@ -1054,7 +1155,181 @@ class TpuEngine:
             itemsize=jnp.dtype(self.compute_dtype).itemsize,
             accum_steps=self.config.gradient_accumulation_steps,
             remat=bool(self.remat_policy and self.remat_policy != "none"),
+            param_wire=self._param_wire,
+            grad_wire=self._grad_wire,
+            hierarchical=self._hier_wire,
         )
+
+    # --------------------------------------------------- wire accounting
+    def _wire_leaf_iter(self, specs_a, specs_b, exclude_key=None):
+        """Yield (shape, dim, axes, n) for every leaf whose ``specs_a``
+        entry carries mesh axes its ``specs_b`` entry doesn't — the
+        leaves a wire collective actually touches. ``exclude_key``
+        masks a top-level subtree (the stacked ``layers`` group when the
+        prefetch stream already prices it)."""
+        from .zero.quantized import gather_dim_and_axes
+
+        if exclude_key is not None and isinstance(specs_a, dict) and (
+            exclude_key in specs_a
+        ):
+            specs_a = {**specs_a, exclude_key: specs_b[exclude_key]}
+        is_spec = lambda s: isinstance(s, P)
+        shapes = jax.tree_util.tree_leaves(self._params_shape)
+        a_flat = jax.tree_util.tree_leaves(specs_a, is_leaf=is_spec)
+        b_flat = jax.tree_util.tree_leaves(specs_b, is_leaf=is_spec)
+        for sh, sa, sb in zip(shapes, a_flat, b_flat):
+            hit = gather_dim_and_axes(sa, sb, len(sh.shape))
+            if hit is None:
+                continue
+            dim, axes = hit
+            n = 1
+            for a in axes:
+                n *= self.topology.sizes[a]
+            if n > 1:
+                yield tuple(int(d) for d in sh.shape), dim, axes, n
+
+    def _leaf_hier(self, axes):
+        """(n_outer, n_inner) when this leaf's wire runs the 2-hop form —
+        the SAME wires.hier_axes predicate the executed collective uses
+        (runtime/zero/quantized.make_leaf_gather), so the priced stream
+        and the traced program can never disagree on eligibility."""
+        from ..comm import wires
+
+        if not self._hier_wire:
+            return None
+        hier = wires.hier_axes(self.topology, axes)
+        if hier is None:
+            return None
+        return hier[1], hier[3]
+
+    def _compute_grad_wire_stream(self):
+        """Static per-device wire bytes of the codec gradient
+        reduce-scatter (qgZ/hgZ; None when no codec wire engages).
+        Stage 1/2: the explicit wired reduction, once per optimizer step
+        (after the accumulation scan) — stage-1 leaves add the f32
+        gather-back half of the decomposed all-reduce, non-dividing
+        leaves stay full-width psum and are reported as such. Stage 3:
+        the gather backward's reduce-scatter, once per microbatch;
+        stacked layers under the prefetch are priced by the
+        zero3_prefetch stream instead (never double-counted)."""
+        from ..comm import wires
+
+        codec = self._grad_wire
+        if codec == "fp32" and not self._hier_wire:
+            return None
+        inter = intra = fullwidth = 0.0
+        hops = 1
+        if self._wired_grad_axes:
+            plan, _ = self._wired_grad_plan()
+            shapes = jax.tree_util.tree_leaves(self._params_shape)
+            axes = self._wired_grad_axes
+            n = 1
+            for a in axes:
+                n *= self.topology.sizes[a]
+            hier = self._leaf_hier(axes)
+            for sh, (kind, dim) in zip(shapes, plan):
+                shape = tuple(int(d) for d in sh.shape)
+                if kind == "psum":
+                    nb = 1
+                    for d in shape:
+                        nb *= d
+                    fullwidth += 2.0 * nb * 4 * (n - 1) / n
+                    continue
+                if hier is not None:
+                    n_o, n_i = hier
+                    hops = 2
+                    leaf_inter, leaf_intra = wires.hier_rs_nbytes(
+                        shape, n_o, n_i, codec, 4, dim=dim
+                    )
+                    inter += leaf_inter
+                    intra += leaf_intra
+                else:
+                    inter += wires.rs_wire_nbytes(shape, n, codec, 4,
+                                                  dim=dim)
+                if kind == "rs_ag":
+                    fullwidth += wires.rs_wire_nbytes(shape, n, "fp32", 4,
+                                                      dim=dim)
+        elif self._qgather is not None:
+            # stage 3: _qgather exists iff a codec or the 2-hop form
+            # engages (the same disjunction the early return tested)
+            accum = max(self.config.gradient_accumulation_steps, 1)
+            exclude = (
+                "layers" if self._z3_prefetch_puts is not None else None
+            )
+            for shape, dim, axes, n in self._wire_leaf_iter(
+                self.param_specs, self._tp_specs, exclude
+            ):
+                hier = self._leaf_hier(axes)
+                if hier is not None:
+                    n_o, n_i = hier
+                    hops = 2
+                    leaf_inter, leaf_intra = wires.hier_rs_nbytes(
+                        shape, n_o, n_i, codec, 4, dim=dim
+                    )
+                    inter += accum * leaf_inter
+                    intra += accum * leaf_intra
+                else:
+                    inter += accum * wires.rs_wire_nbytes(
+                        shape, n, codec, 4, dim=dim
+                    )
+        total = inter + intra + fullwidth
+        if total <= 0:
+            return None
+        return {
+            "codec": codec,
+            "bytes_per_step": int(total),
+            "inter_bytes_per_step": int(inter),
+            "intra_bytes_per_step": int(intra),
+            "fullwidth_bytes_per_step": int(fullwidth),
+            "hierarchical": hops == 2,
+        }
+
+    def _compute_param_wire_stream(self):
+        """Static per-device wire bytes of the codec stage-3 parameter
+        all-gathers (qwZ; None when no codec gather engages). One gather
+        per microbatch forward, plus the remat re-gather; stacked layers
+        under the prefetch are priced by the zero3_prefetch stream."""
+        from ..comm import wires
+
+        codec = self._param_wire
+        if self._qgather is None or (codec == "fp32"
+                                     and not self._hier_wire):
+            return None
+        accum = max(self.config.gradient_accumulation_steps, 1)
+        remat = bool(self.remat_policy and self.remat_policy != "none")
+        passes = accum * (2 if remat else 1)
+        inter = intra = 0.0
+        hops = 1
+        exclude = "layers" if self._z3_prefetch_puts is not None else None
+        for shape, dim, axes, n in self._wire_leaf_iter(
+            self.param_specs, self._tp_specs, exclude
+        ):
+            hier = self._leaf_hier(axes)
+            if hier is not None:
+                n_o, n_i = hier
+                hops = 2
+                leaf_inter, leaf_intra = wires.hier_ag_nbytes(
+                    shape, n_o, n_i, codec, 4, dim=dim
+                )
+                inter += passes * leaf_inter
+                intra += passes * leaf_intra
+            else:
+                shard = list(shape)
+                shard[dim] //= n
+                inter += passes * wires.ag_wire_nbytes(
+                    shard, n, codec, 4, dim=dim
+                )
+        total = inter + intra
+        if total <= 0:
+            return None
+        return {
+            "codec": codec,
+            "bytes_per_step": int(total),
+            "inter_bytes_per_step": int(inter),
+            "intra_bytes_per_step": int(intra),
+            "hierarchical": hops == 2,
+            "passes": passes,
+        }
 
     # ------------------------------------------------------------------ step
     def _device_params(self, params):
@@ -1328,6 +1603,169 @@ class TpuEngine:
             pld if has_pld else jnp.zeros((), jnp.float32),
         )
 
+    def _wired_grad_plan(self):
+        """Per-leaf reduction plan for the stage-1/2 grad wire, aligned
+        with the flattened param tree: ``("rs", dim)`` — the leaf's grad
+        spec carries the data axes (stage 2: reduce-scatter straight
+        into its resting layout); ``("rs_ag", dim)`` — replicated-grad
+        leaf with a dividable dim (stage 1: the decomposed all-reduce —
+        codec reduce-scatter + full-width f32 gather of the reduced
+        shards, the qgZ split of an all-reduce); ``("psum", None)`` —
+        nothing divides, full-width psum (honest: no wire saving there).
+        Second return: the shard_map out_specs tree (manual data axes
+        only — tp sharding rides the automatic axes)."""
+        from .zero.partition import add_data_axes
+        from .zero.quantized import gather_dim_and_axes
+
+        axes = self._wired_grad_axes
+        is_spec = lambda s: isinstance(s, P)
+        shapes_flat, treedef = jax.tree_util.tree_flatten(self._params_shape)
+        gspecs = jax.tree_util.tree_leaves(self.grad_specs, is_leaf=is_spec)
+        tspecs = jax.tree_util.tree_leaves(self._tp_specs, is_leaf=is_spec)
+        plan, out_flat = [], []
+        for sh, gs, ts in zip(shapes_flat, gspecs, tspecs):
+            ndim = len(sh.shape)
+            hit = gather_dim_and_axes(gs, ts, ndim)
+            if hit is not None and set(hit[1]) == set(axes):
+                dim = hit[0]
+                plan.append(("rs", dim))
+                entries = list(gs) + [None] * (ndim - len(gs))
+                proj = []
+                for e in entries:
+                    es = e if isinstance(e, tuple) else ((e,) if e else ())
+                    kept = tuple(a for a in es if a in axes)
+                    proj.append(
+                        kept if len(kept) > 1
+                        else (kept[0] if kept else None)
+                    )
+                out_flat.append(P(*proj))
+                continue
+            cand = add_data_axes(ts, sh.shape, self.topology, axes)
+            hit2 = gather_dim_and_axes(cand, ts, ndim)
+            plan.append(
+                ("rs_ag", hit2[0]) if hit2 is not None else ("psum", None)
+            )
+            out_flat.append(P())
+        return plan, jax.tree_util.tree_unflatten(treedef, out_flat)
+
+    def _compute_grads_wired(self, params, batch, rng, scale, step,
+                             ltd_keep=None):
+        """(grads fp32 in their resting layout, mean loss) with the
+        cross-member gradient reduction run as the explicit wire-codec
+        reduce-scatter (qgZ): member-local grads compute inside a
+        shard_map over the data axes, each leaf's blocks quantize ONCE,
+        the accumulate runs after dequant in f32 (master precision), and
+        the f32 mean lands in the leaf's grad_specs layout. Like the
+        1-bit wire path, model metrics don't ride (loss only)."""
+        from ..comm import wires
+
+        topo = self.topology
+        axes = self._wired_grad_axes
+        ax_entry = axes if len(axes) > 1 else axes[0]
+        accum = self.config.gradient_accumulation_steps
+        grad_fn = jax.value_and_grad(self._loss_for, has_aux=True)
+        pld = self._pld_keep(step)
+        has_pld = pld is not None
+        n_members = 1
+        for a in axes:
+            n_members *= topo.sizes[a]
+        hier = wires.hier_axes(topo, axes) if self._hier_wire else None
+        plan, grads_out_specs = self._wired_grad_plan()
+        codec = self._grad_wire
+        inv_members = 1.0 / float(n_members)
+
+        def reduce_leaf(g, kind, dim):
+            if kind == "psum":
+                return lax.psum(g, axes) * inv_members
+            if hier is not None:
+                o, n_o, i_ax, n_i = hier
+                red = wires.rs_wire_hier_local(
+                    g, o, i_ax, n_o, n_i, codec, dim=dim,
+                    dtype=jnp.float32,
+                )
+            else:
+                red = wires.rs_wire_local(
+                    g, ax_entry, n_members, codec, dim=dim,
+                    dtype=jnp.float32,
+                )
+            red = red * inv_members
+            if kind == "rs_ag":
+                red = jnp.moveaxis(
+                    lax.all_gather(
+                        jnp.moveaxis(red, dim, 0), axes, axis=0, tiled=True
+                    ),
+                    0, dim,
+                )
+            return red
+
+        def local_fn(params, batch, key, scale, pld_keep):
+            pk = pld_keep if has_pld else None
+            if accum == 1:
+                (_, (loss, _m)), grads = grad_fn(
+                    params,
+                    jax.tree.map(lambda x: x[0], batch),
+                    jax.random.fold_in(key, 0),
+                    scale,
+                    pk,
+                    ltd_keep,
+                )
+                inv = 1.0 / scale
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) * inv, grads
+                )
+            else:
+                zero_grads = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params
+                )
+
+                def accum_body(carry, xs):
+                    g_acc, loss_acc = carry
+                    mb, k = xs
+                    (_, (loss, _m)), grads = grad_fn(
+                        params, mb, k, scale, pk, ltd_keep
+                    )
+                    g_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+                    )
+                    return (g_acc, loss_acc + loss), None
+
+                keys = jax.random.split(key, accum)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    accum_body,
+                    (zero_grads, jnp.zeros((), jnp.float32)),
+                    (batch, keys),
+                )
+                inv = 1.0 / (accum * scale)
+                grads = jax.tree.map(lambda g: g * inv, grads)
+                loss = loss_sum / accum
+            leaves = jax.tree_util.tree_structure(params).flatten_up_to(
+                grads
+            )
+            reduced = [
+                reduce_leaf(g, kind, dim)
+                for g, (kind, dim) in zip(leaves, plan)
+            ]
+            grads = jax.tree_util.tree_structure(params).unflatten(reduced)
+            return grads, jax.lax.pmean(loss, axes)
+
+        from ..utils.jax_compat import shard_map
+
+        run = shard_map(
+            local_fn,
+            mesh=topo.mesh,
+            in_specs=(P(), P(None, ax_entry), P(), P(), P()),
+            out_specs=(grads_out_specs, P()),
+            axis_names=set(axes),
+            check_vma=False,
+        )
+        return run(
+            params,
+            batch,
+            rng,
+            scale,
+            pld if has_pld else jnp.zeros((), jnp.float32),
+        )
+
     def _grads_and_loss(self, params, loss_scale, step, batch, rng,
                         ltd_keep=None):
         """The fwd+bwd half of the step: (grads fp32, loss). Compiled
@@ -1341,6 +1779,11 @@ class TpuEngine:
                 params, batch, rng, scale, step, ltd_keep
             )
             mmetrics = {}  # 1-bit wire path: loss only (local stacked grads)
+        elif self._wired_grad_axes:
+            grads, loss = self._compute_grads_wired(
+                params, batch, rng, scale, step, ltd_keep
+            )
+            mmetrics = {}  # wire path: loss only (like the 1-bit path)
         else:
             grads, loss, mmetrics = self._compute_grads(
                 params, batch, rng, scale, step, ltd_keep

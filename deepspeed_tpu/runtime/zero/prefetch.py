@@ -83,9 +83,28 @@ def prefetch_scope(puts):
 
 
 # ------------------------------------------------------------- put derivation
+class WirePut:
+    """A per-leaf wire-codec gather standing in for a device_put target in
+    the puts tree: calling it gathers one layer slice's shard through the
+    shared codec collectives (runtime/zero/quantized.make_leaf_gather —
+    the SAME program the whole-tree ZeRO++ gather uses, so the prefetched
+    gather moves codec bytes and its custom backward reduce-scatters the
+    layer gradient in ``grad_wire`` bytes)."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
 def build_layer_puts(params_shape, tp_specs, param_specs, topology,
-                     stacked_key: str = "layers") -> Optional[Any]:
-    """Per-layer-slice gather shardings for the stacked ``layers`` group.
+                     stacked_key: str = "layers", *,
+                     param_wire: str = "fp32", grad_wire: str = "fp32",
+                     hierarchical: bool = False) -> Optional[Any]:
+    """Per-layer-slice gather targets for the stacked ``layers`` group.
 
     For every stacked leaf [L, ...] the gathered layout is its tp spec
     with the leading (layer) entry dropped — exactly the layout the layer
@@ -94,7 +113,14 @@ def build_layer_puts(params_shape, tp_specs, param_specs, topology,
     the same (identity) put, which compiles away. Returns None when the
     model has no stacked ``layers`` dict or when NO leaf is actually
     data-sharded (nothing to prefetch — the knob would buy pure
-    overhead)."""
+    overhead).
+
+    With a non-fp32 ``param_wire`` / ``grad_wire`` codec
+    (zero_optimization wire knobs, docs/wires.md) the data-sharded
+    leaves come back as :class:`WirePut` callables instead of
+    shardings: the prefetched gather then moves codec bytes over the
+    wire and its backward reduce-scatters the gradient in ``grad_wire``
+    bytes — composition, not a separate mechanism."""
     if not (isinstance(params_shape, dict) and stacked_key in params_shape
             and isinstance(tp_specs, dict) and stacked_key in tp_specs):
         return None
@@ -104,22 +130,41 @@ def build_layer_puts(params_shape, tp_specs, param_specs, topology,
         entries = tuple(spec)
         return P(*entries[1:]) if entries else P()
 
+    is_spec = lambda s: isinstance(s, P)
+    t_leaves = jax.tree_util.tree_leaves(tp_specs[stacked_key],
+                                         is_leaf=is_spec)
+    p_leaves = jax.tree_util.tree_leaves(param_specs[stacked_key],
+                                         is_leaf=is_spec)
     any_sharded = any(
-        tuple(t) != tuple(p)
-        for t, p in zip(
-            jax.tree_util.tree_leaves(
-                tp_specs[stacked_key], is_leaf=lambda s: isinstance(s, P)
-            ),
-            jax.tree_util.tree_leaves(
-                param_specs[stacked_key], is_leaf=lambda s: isinstance(s, P)
-            ),
-        )
+        tuple(t) != tuple(p) for t, p in zip(t_leaves, p_leaves)
     )
     if not any_sharded:
         return None
+    wired = param_wire != "fp32" or grad_wire != "fp32" or hierarchical
+    if not wired:
+        return jax.tree.map(
+            lambda spec: NamedSharding(mesh, drop_lead(spec)),
+            tp_specs[stacked_key],
+            is_leaf=is_spec,
+        )
+
+    from .quantized import make_leaf_gather
+
+    def put_for(shape_leaf, tpspec, pspec):
+        fn = make_leaf_gather(
+            topology, drop_lead(pspec), drop_lead(tpspec),
+            tuple(shape_leaf.shape[1:]), param_wire, grad_wire,
+            hierarchical,
+        )
+        if fn is None:  # persistent/replicated slice: identity put
+            return NamedSharding(mesh, drop_lead(tpspec))
+        return WirePut(fn)
+
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, drop_lead(spec)),
+        put_for,
+        params_shape[stacked_key],
         tp_specs[stacked_key],
+        param_specs[stacked_key],
         is_leaf=lambda s: isinstance(s, P),
     )
 
@@ -142,7 +187,14 @@ def scan_layers(body, carry, layers_seg, extras, puts):
     L = jax.tree_util.tree_leaves(layers_seg)[0].shape[0]
 
     def gather(sl):
-        return jax.tree.map(jax.device_put, sl, puts)
+        # puts leaves are shardings (plain device_put gather) or WirePut
+        # codec gathers (zero_optimization.param_wire / grad_wire)
+        return jax.tree.map(
+            lambda x, p: p(x) if isinstance(p, WirePut)
+            else jax.device_put(x, p),
+            sl,
+            puts,
+        )
 
     def slice_at(i):
         return jax.tree.map(
@@ -171,19 +223,27 @@ def scan_layers(body, carry, layers_seg, extras, puts):
 def prefetch_wire_bytes_per_step(params_shape, tp_specs, param_specs,
                                  topology, *, itemsize: int = 2,
                                  accum_steps: int = 1, remat: bool = True,
-                                 stacked_key: str = "layers"
+                                 stacked_key: str = "layers",
+                                 param_wire: str = "fp32",
+                                 grad_wire: str = "fp32",
+                                 hierarchical: bool = False
                                  ) -> Optional[dict]:
     """Analytic per-device all-gather wire for the prefetched layer scan.
 
     Per data-sharded stacked leaf, one gather per layer per pass moves
-    ``slice_bytes × (n−1)/n`` onto each device (ring all-gather, n = the
-    product of the leaf's added data axes). Passes per optimizer step:
-    forward + the backward's gradient reduce-scatter transpose, plus the
-    remat re-gather when a checkpoint policy replays the forward.
-    ``itemsize`` is the COMPUTE dtype's (the scan gathers cast weights,
-    not f32 masters). None when nothing is data-sharded."""
+    its encoded slice's ``(n−1)/n`` onto each device (ring all-gather,
+    n = the product of the leaf's added data axes). Passes per optimizer
+    step: forward + the backward's gradient reduce-scatter transpose,
+    plus the remat re-gather when a checkpoint policy replays the
+    forward. Gather passes are priced at the ``param_wire`` codec and
+    the backward scatter pass at ``grad_wire`` (comm/wires.py byte
+    accounting — the win rule R8 sees statically). ``itemsize`` is the
+    COMPUTE dtype's (the scan gathers cast weights, not f32 masters).
+    None when nothing is data-sharded."""
     if not (isinstance(params_shape, dict) and stacked_key in params_shape):
         return None
+    from ...comm import wires
+
     sizes = topology.sizes
     leaves = zip(
         jax.tree_util.tree_leaves(params_shape[stacked_key]),
@@ -194,39 +254,65 @@ def prefetch_wire_bytes_per_step(params_shape, tp_specs, param_specs,
             param_specs[stacked_key], is_leaf=lambda s: isinstance(s, P)
         ),
     )
-    per_pass = 0.0
+    gather_pass = 0.0   # one fwd traversal, param_wire bytes
+    scatter_pass = 0.0  # the bwd grad reduce-scatter, grad_wire bytes
     n_layers = 0
     for leaf, tp_spec, p_spec in leaves:
         t, q = tuple(tp_spec), tuple(p_spec)
         if t == q:
             continue  # persistent / replicated: identity put, no wire
-        added = set()
-        for entry in q:
-            for a in (entry if isinstance(entry, tuple) else (entry,)):
-                if a:
-                    added.add(a)
-        for entry in t:
-            for a in (entry if isinstance(entry, tuple) else (entry,)):
-                if a:
-                    added.discard(a)
+        from .quantized import gather_dim_and_axes
+
+        slice_shape = tuple(int(d) for d in leaf.shape[1:])
+        hit = gather_dim_and_axes(
+            P(*q[1:]), P(*t[1:]), len(slice_shape)
+        )
+        if hit is None:
+            continue
+        dim, axes = hit
         n = 1
-        for a in added:
+        for a in axes:
             n *= sizes.get(a, 1)
-        if n <= 1:
+        if n <= 1 or slice_shape[dim] % n:
             continue
         n_layers = max(n_layers, int(leaf.shape[0]))
-        slice_elems = 1
-        for d in leaf.shape[1:]:
-            slice_elems *= int(d)
-        per_pass += leaf.shape[0] * slice_elems * itemsize * (n - 1) / n
-    if per_pass <= 0:
+        L = int(leaf.shape[0])
+        hier = wires.hier_axes(topology, axes) if hierarchical else None
+        if hier is not None:
+            _o, n_o, _i, n_i = hier
+            gather_pass += L * sum(wires.hier_ag_nbytes(
+                slice_shape, n_o, n_i, param_wire, itemsize, dim=dim
+            ))
+            scatter_pass += L * sum(wires.hier_rs_nbytes(
+                slice_shape, n_o, n_i, grad_wire, itemsize, dim=dim
+            ))
+            continue
+        shard_shape = list(slice_shape)
+        shard_shape[dim] //= n
+        gather_pass += L * wires.ag_wire_nbytes(
+            shard_shape, n, param_wire, itemsize, dim=dim
+        )
+        # the bwd scatters the cotangent slice in grad_wire bytes (qgZ:
+        # quantize-once blocks + f32 accumulate). The cotangent is the
+        # COMPUTE dtype — the model casts the stacked layers before the
+        # scan, so the gather site (and its transpose) moves cast
+        # weights, hence ``itemsize`` prices the fp32-codec case
+        scatter_pass += L * wires.rs_wire_nbytes(
+            slice_shape, n, grad_wire, itemsize, dim=dim
+        )
+    if gather_pass <= 0:
         return None
     passes = 2 + (1 if remat else 0)  # fwd gather + bwd scatter (+ regather)
-    total = per_pass * passes * max(accum_steps, 1)
+    gather_passes = 1 + (1 if remat else 0)
+    per_step = (gather_pass * gather_passes + scatter_pass) * max(
+        accum_steps, 1
+    )
     return {
-        "bytes_per_step": int(total),
-        "fwd_bytes_per_step": int(per_pass * max(accum_steps, 1)),
+        "bytes_per_step": int(per_step),
+        "fwd_bytes_per_step": int(gather_pass * max(accum_steps, 1)),
         "layers": n_layers,
         "slots": 2,
         "passes": passes,
+        "param_wire": param_wire,
+        "grad_wire": grad_wire,
     }

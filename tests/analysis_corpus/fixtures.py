@@ -22,6 +22,12 @@ trace-ready evidence of one statically-visible bug class:
   (the staleness the functional prefetch carry avoids by construction)
 - ``truncated_master``      R5: f32 master rebuilt through bf16
 - ``pinned_host_compute``   R5: host-resident bytes fed to compute
+- ``grad_wire_truncates_master`` R5: an int8 grad wire whose dequantized
+  blocks accumulate into the master through bf16 instead of f32 (the
+  qgZ dequant-accumulate contract of comm/wires.py)
+- ``hier_wire_bad_split``   R3: a hand-rolled hierarchical 2-hop wire
+  whose intra-group ring permutation maps two members onto one (the
+  clean twin traces the real comm/wires.py 2-hop reduce-scatter)
 - ``hbm_over_budget``       R6: estimated peak exceeds the HBM budget
 - ``autotuner_rung_oom``    R6: a fat-micro autotuner rung statically
   over the shared budget (the planner-search prune; the clean twin is
@@ -523,6 +529,111 @@ def zero3_prefetch_stale_slot_clean():
     return _prefetch_slots(False), {}, "R4"
 
 
+# ------------------------------------------------------------------ R5 ter
+def _grad_wire_update(truncate: bool):
+    """The qgZ contract at the master update: an int8 grad wire is only
+    sound when the dequantized blocks ACCUMULATE INTO THE MASTER IN F32
+    (comm/wires.py decodes to f32 before any sum). The hazard books the
+    wire-decoded gradient into the master through a bf16 accumulate —
+    every path from the f32 master input to the f32 master output passes
+    through a sub-32-bit float, the exact bf16-in-f32-clothing drift R5
+    exists to catch. The clean twin is the dequant-accumulate-in-f32
+    path the engine's wired reduction ships."""
+
+    def prog(master, g):
+        # the int8 wire leg (shared lane-wise scheme, fake-quant form)
+        amax = jnp.max(jnp.abs(g), axis=0, keepdims=True)
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+        deq = q.astype(jnp.float32) * scale
+        if truncate:
+            new = (
+                master.astype(jnp.bfloat16)
+                - 0.1 * deq.astype(jnp.bfloat16)
+            ).astype(jnp.float32)
+        else:
+            new = master - 0.1 * deq
+        return new
+
+    m = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    g = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    closed = jax.make_jaxpr(prog)(m, g)
+    return closed, {"master_pairs": [(0, 0, "master")]}
+
+
+def grad_wire_truncates_master():
+    closed, kw = _grad_wire_update(True)
+    return closed, kw, "R5"
+
+
+def grad_wire_truncates_master_clean():
+    closed, kw = _grad_wire_update(False)
+    return closed, kw, "R5"
+
+
+# ------------------------------------------------------------------ R3 ter
+# hierarchical 2-hop wire (comm/wires.py): the clean twin traces the REAL
+# reduce_scatter_wire(hierarchical=True) program over a factored dp x fsdp
+# mesh; the hazard is the same 2-hop shape hand-rolled with a raw
+# lax.ppermute whose intra-group ring permutation maps two members onto
+# one — a malformed group split that hangs the inner hop on real ICI
+# (bypassing comm.collectives.permute's construction-time contract)
+def _hier_topo():
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    return MeshTopology(dims=ParallelDims(dp=2, fsdp=4))
+
+
+def hier_wire_bad_split():
+    topo = _hier_topo()
+    n_i = 4
+    # inner "ring" 0→1→2→3 closed back to 1: duplicate destination — the
+    # intra-group exchange desynchronizes and hangs members on real ICI
+    perm = [(0, 1), (1, 2), (2, 3), (3, 1)]
+
+    def body(x):
+        # hand-rolled hop 1: ride-the-ring partial accumulation over fsdp
+        i = lax.axis_index("fsdp")
+        chunk = x.shape[0] // n_i
+
+        def part(blk):
+            return lax.dynamic_slice(
+                x, (blk * chunk, 0), (chunk, x.shape[1])
+            ).astype(jnp.float32)
+
+        acc = part((i - 1) % n_i)
+        for s in range(1, n_i):
+            acc = lax.ppermute(acc, "fsdp", perm)
+            acc = acc + part((i - 1 - s) % n_i)
+        # hop 2: the inter-group reduction over dp
+        return lax.psum(acc, "dp")
+
+    fn = shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=P(("dp", "fsdp")),
+        out_specs=P("fsdp"),
+        axis_names=set(topo.mesh.axis_names),
+        check_vma=False,
+    )
+    x = jax.ShapeDtypeStruct((32, 8), jnp.float32)
+    return jax.make_jaxpr(fn)(x), {"mesh": topo.mesh}, "R3"
+
+
+def hier_wire_bad_split_clean():
+    from deepspeed_tpu.comm.wires import reduce_scatter_wire
+
+    topo = _hier_topo()
+
+    def prog(contribs):
+        return reduce_scatter_wire(
+            contribs, topo, ("dp", "fsdp"), "int8", hierarchical=True
+        )
+
+    contribs = jax.ShapeDtypeStruct((8, 32, 8), jnp.float32)
+    return jax.make_jaxpr(prog)(contribs), {"mesh": topo.mesh}, "R3"
+
+
 # --------------------------------------------------------------------- R6
 def _budget_prog():
     mesh = corpus_mesh()
@@ -666,6 +777,8 @@ HAZARDS = [
     tp_overlap_malformed_ring,
     moe_a2a_malformed_ring,
     zero3_prefetch_stale_slot,
+    grad_wire_truncates_master,
+    hier_wire_bad_split,
     hbm_over_budget,
     autotuner_rung_oom,
     reshard_transpose_pair,
@@ -685,6 +798,8 @@ CLEAN_TWINS = [
     tp_overlap_ring_clean,
     moe_a2a_ring_clean,
     zero3_prefetch_stale_slot_clean,
+    grad_wire_truncates_master_clean,
+    hier_wire_bad_split_clean,
     hbm_over_budget_clean,
     autotuner_rung_oom_clean,
     reshard_transpose_pair_clean,

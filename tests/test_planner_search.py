@@ -49,28 +49,46 @@ def test_candidate_space_enumeration():
             "autotuning": {"max_train_micro_batch_size_per_gpu": 4}}
     cands = _search(base).candidates()
     labels = {c.label() for c in cands}
-    # the stage-3 ladder rungs double for the layer-prefetch on/off axis
-    n_stage3 = sum(1 for z in ZERO_LADDER if z["stage"] == 3)
-    ladder_units = len(ZERO_LADDER) + n_stage3
+    # per-rung axis multipliers: stage-3 rungs carry the layer-prefetch
+    # on/off axis AND both wire-codec axes (grad x param, 2 codecs each);
+    # stage-1/2 rungs carry the grad-wire axis only (ISSUE 12)
+    def units(stage):
+        if stage == 3:
+            return 2 * 2 * 2  # z3pf x grad_wire x param_wire
+        if stage >= 1:
+            return 2          # grad_wire
+        return 1
+    ladder_units = sum(units(z["stage"]) for z in ZERO_LADDER)
     assert len(cands) == ladder_units * len(REMAT_POLICIES) * 3
     assert "z0/none/mb1" in labels and "z3off/full/mb4/z3pf" in labels
+    assert "z3/none/mb1/z3pf/gw-int8/pw-int8" in labels
     assert {c.z3_prefetch for c in cands if c.stage == 3} == {False, True}
     assert all(c.z3_prefetch is None for c in cands if c.stage != 3)
+    assert {c.grad_wire for c in cands if c.stage >= 1} == {"fp32", "int8"}
+    assert all(c.grad_wire is None for c in cands if c.stage == 0)
+    assert all(c.param_wire is None for c in cands if c.stage != 3)
+    # the wire axis collapses on request (heavier tests keep trace
+    # counts flat with wire_codecs=("fp32",))
+    collapsed = _search(base, wire_codecs=("fp32",)).candidates()
+    n_stage3 = sum(1 for z in ZERO_LADDER if z["stage"] == 3)
+    assert len(collapsed) == (
+        (len(ZERO_LADDER) + n_stage3) * len(REMAT_POLICIES) * 3
+    )
 
     pinned = dict(base, zero_optimization={"stage": 1})
     cands = _search(pinned).candidates()
-    assert len(cands) == len(REMAT_POLICIES) * 3
+    assert len(cands) == len(REMAT_POLICIES) * 3 * 2  # x grad_wire
     assert all(c.zero is None for c in cands)
 
     tp = dict(pinned, tensor_parallel={"tp_size": 2})
-    cands = _search(tp).candidates()
+    cands = _search(tp, wire_codecs=("fp32",)).candidates()
     assert len(cands) == len(REMAT_POLICIES) * 3 * 2
     assert {c.tp_overlap for c in cands} == {False, True}
 
     # expert parallelism adds the decomposed-a2a on/off axis (ISSUE 10)
     moe = dict(pinned, moe={"enabled": True, "ep_size": 2,
                             "num_experts": 4})
-    cands = _search(moe).candidates()
+    cands = _search(moe, wire_codecs=("fp32",)).candidates()
     assert len(cands) == len(REMAT_POLICIES) * 3 * 2
     assert {c.moe_a2a for c in cands} == {False, True}
     assert any("a2aov" in c.label() for c in cands)
@@ -98,7 +116,8 @@ def test_new_overlap_axes_reach_plans_and_configs(devices8):
         "autotuning": {"max_train_micro_batch_size_per_gpu": 1,
                        "tune_zero": False},
     }
-    search = PlannerSearch(model, base, None, top_k=1)
+    search = PlannerSearch(model, base, None, top_k=1,
+                           wire_codecs=("fp32",))
     cands = search.candidates()
     assert {(c.moe_a2a, c.z3_prefetch) for c in cands} == {
         (False, False), (False, True), (True, False), (True, True),
@@ -128,7 +147,8 @@ def test_static_prune_rank_and_explain(devices8):
     base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": 0},
             "autotuning": {"max_train_micro_batch_size_per_gpu": 8}}
-    res = _search(base, top_k=2, hbm_budget_bytes=1_200_000).search()
+    res = _search(base, top_k=2, hbm_budget_bytes=1_200_000,
+                  wire_codecs=("fp32",)).search()
     assert res.pruned and res.survivors
     assert len(res.top_k) == 2
     for pc in res.pruned:
@@ -151,7 +171,8 @@ def test_memoized_scaling_skips_retrace(devices8):
     base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": 0},
             "autotuning": {"max_train_micro_batch_size_per_gpu": 8}}
-    res = _search(base, hbm_budget_bytes=1_200_000).search()
+    res = _search(base, hbm_budget_bytes=1_200_000,
+                  wire_codecs=("fp32",)).search()
     by_group = {}
     for pc in res.planned:
         by_group.setdefault(pc.cand.group_key(), []).append(pc)

@@ -1,0 +1,429 @@
+"""Wire codecs + wire collectives (ISSUE 12, comm/wires.py).
+
+Oracles pinned here:
+
+- per-codec round trips respect the DOCUMENTED error bound
+  ``|decode(encode(x)) - x| <= codec.bound(x)`` — including zero lanes,
+  denormal lanes and odd (int4-padded) row counts; fp32 is bitwise, bf16
+  is bitwise on bf16 inputs;
+- CPU-mesh collectives: the codec reduce-scatter / all-gather match the
+  full-width forms within the codec's stated bound (bitwise for the fp32
+  wire) on odd AND even member counts, single-hop and hierarchical 2-hop;
+- engine-level: the stage-1/2 wired gradient reduction tracks the dense
+  trajectory within codec tolerance, the wire spelling of ZeRO++
+  (grad_wire/param_wire) is BITWISE the legacy zero_quantized_* path,
+  f32 masters stay f32 with shardlint R5 clean (the one-untruncated-
+  master-path contract), the prefetch composition moves codec bytes, and
+  every wire's bytes appear in ``analytic_streams()``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.comm import wires
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.models import gpt2
+
+
+def _special_blocks(rng, b, r, lanes):
+    """Random blocks with the adversarial lanes the bounds must survive:
+    an all-zero lane, a denormal lane, and a huge-dynamic-range lane."""
+    x = rng.randn(b, r, lanes).astype(np.float32) * 3.0
+    x[:, :, 0] = 0.0                     # zero lane
+    x[:, :, 1] = 1e-40                   # denormal lane
+    if lanes > 2:
+        x[:, :, 2] *= 1e4                # big lane
+    return jnp.asarray(x)
+
+
+# ------------------------------------------------------------------ codecs
+@pytest.mark.parametrize("name", wires.WIRE_NAMES)
+@pytest.mark.parametrize("rows", [8, 7])  # even and odd (int4 pack pad)
+def test_codec_roundtrip_respects_stated_bound(name, rows):
+    rng = np.random.RandomState(0)
+    x = _special_blocks(rng, 2, rows, 5)
+    codec = wires.get_codec(name)
+    y = codec.decode(codec.encode(x), rows, jnp.float32)
+    err = np.abs(np.asarray(y) - np.asarray(x))
+    bound = np.broadcast_to(np.asarray(codec.bound(x)), x.shape)
+    assert (err <= bound + 1e-12).all(), (
+        name, err.max(), bound[err > bound + 1e-12],
+    )
+    if name == "fp32":
+        assert np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_bf16_codec_is_identity_on_bf16_inputs():
+    x = jnp.asarray(
+        np.random.RandomState(1).randn(1, 8, 4), jnp.bfloat16
+    ).astype(jnp.float32)  # exactly-representable values
+    codec = wires.get_codec("bf16")
+    y = codec.decode(codec.encode(x), 8, jnp.float32)
+    assert np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_int4_packs_two_codes_per_byte():
+    x = jnp.asarray(np.random.RandomState(2).randn(1, 10, 6), jnp.float32)
+    p = wires.get_codec("int4").encode(x)
+    assert p["q"].shape == (1, 5, 6) and p["q"].dtype == jnp.int8
+    # declared wire bytes: payload + fp32 lane scales
+    assert wires.get_codec("int4").payload_nbytes(1, 10, 6) == 5 * 6 + 6 * 4
+    assert wires.get_codec("int8").payload_nbytes(1, 10, 6) == 10 * 6 + 6 * 4
+    assert wires.get_codec("bf16").payload_nbytes(1, 10, 6) == 10 * 6 * 2
+    assert wires.get_codec("fp32").payload_nbytes(1, 10, 6, 4) == 10 * 6 * 4
+
+
+def test_unknown_codec_rejected():
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        wires.get_codec("int3")
+
+
+def test_shared_lanewise_entry_matches_int8_codec():
+    """quantize_lanewise (the TP-ring / ZeRO++ entry) IS the int8 codec."""
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 8), jnp.float32)
+    q, scale = wires.quantize_lanewise(x)
+    p = wires.get_codec("int8").encode(x[None])
+    assert np.array_equal(np.asarray(q), np.asarray(p["q"][0]))
+    assert np.array_equal(np.asarray(scale), np.asarray(p["scale"][0]))
+
+
+# -------------------------------------------------------- mesh collectives
+def _topo(n=8, **dims):
+    comm.destroy_process_group()
+    topo = MeshTopology(
+        ParallelDims(**dims) if dims else ParallelDims(dp=n),
+        devices=jax.devices()[:n],
+    )
+    comm.set_topology(topo)
+    return topo
+
+
+def _rs_bound(contribs, n, codec):
+    """Exact accumulated bound: each member's blocks quantize once, the
+    f32 sum adds their per-block bounds elementwise."""
+    c = wires.get_codec(codec)
+    d = contribs.shape[1]
+    total = np.zeros((n, d // n, contribs.shape[2]), np.float32)
+    for m in range(n):
+        x3 = jnp.asarray(contribs[m]).reshape(n, d // n, -1)
+        total += np.broadcast_to(np.asarray(c.bound(x3)), total.shape)
+    return total
+
+
+@pytest.mark.parametrize("n", [8, 5])   # even and odd member counts
+@pytest.mark.parametrize("codec", ["fp32", "bf16", "int8", "int4"])
+def test_reduce_scatter_wire_matches_fullwidth(n, codec, devices8):
+    topo = _topo(n)
+    rng = np.random.RandomState(4)
+    d, lanes = 5 * n, 6   # odd per-block row count (5): int4 pack padding
+    contribs = np.asarray(
+        _special_blocks(rng, n, d, lanes), np.float32
+    )
+    out = wires.reduce_scatter_wire(
+        jnp.asarray(contribs), topo, ("dp",), codec
+    )
+    # pinned member-order f32 sum, computed through XLA (the wire's adds
+    # run inside XLA, which flushes denormals on CPU — a numpy reference
+    # would disagree on the denormal lane only)
+    import functools
+
+    ref = np.asarray(functools.reduce(
+        jnp.add, [jnp.asarray(contribs[m]) for m in range(n)]
+    ))
+    got = np.asarray(out).reshape(d, lanes)
+    if codec == "fp32":
+        assert np.array_equal(got, ref)
+        return
+    bound = _rs_bound(contribs, n, codec).reshape(d, lanes)
+    assert (np.abs(got - ref) <= bound + 1e-6).all(), (
+        codec, np.abs(got - ref).max(), bound.max(),
+    )
+
+
+@pytest.mark.parametrize("n", [8, 5])
+@pytest.mark.parametrize("codec", ["fp32", "bf16", "int8", "int4"])
+def test_all_gather_wire_matches_fullwidth(n, codec, devices8):
+    topo = _topo(n)
+    rng = np.random.RandomState(5)
+    shards = np.asarray(_special_blocks(rng, n, 3, 5), np.float32)
+    out = np.asarray(
+        wires.all_gather_wire(jnp.asarray(shards), topo, ("dp",), codec)
+    )
+    full = shards.reshape(n * 3, 5)
+    if codec == "fp32":
+        assert np.array_equal(out, full)
+        return
+    c = wires.get_codec(codec)
+    bounds = np.concatenate([
+        np.broadcast_to(
+            np.asarray(c.bound(jnp.asarray(shards[m][None]))),
+            (1, 3, 5),
+        )[0]
+        for m in range(n)
+    ])
+    assert (np.abs(out - full) <= bounds + 1e-6).all()
+
+
+@pytest.mark.parametrize("dims", [dict(dp=2, fsdp=4), dict(dp=4, fsdp=2)])
+def test_hierarchical_wire_oracle(dims, devices8):
+    """2-hop == single-hop full-width within the INTER-hop codec bound
+    (quantization happens at most once, on the group partials); the fp32
+    2-hop wire is bitwise the 2-hop-ordered host sum, and the block
+    layout is outer-major (the P((dp, fsdp)) contract)."""
+    topo = _topo(8, **dims)
+    n_o, n_i = dims["dp"], dims["fsdp"]
+    n = n_o * n_i
+    rng = np.random.RandomState(6)
+    d, lanes = 2 * n, 4
+    contribs = np.asarray(_special_blocks(rng, n, d, lanes), np.float32)
+    x = jnp.asarray(contribs)
+
+    h32 = np.asarray(wires.reduce_scatter_wire(
+        x, topo, ("dp", "fsdp"), "fp32", hierarchical=True
+    )).reshape(d, lanes)
+    # 2-hop-ordered reference: inner (group) sums first, then the outer
+    # member-order sum of the group partials — bitwise. Computed through
+    # XLA (CPU flushes denormals; numpy would disagree on that lane).
+    import functools
+
+    groups = contribs.reshape(n_o, n_i, d, lanes)
+    partials = np.stack([
+        np.asarray(functools.reduce(
+            jnp.add, [jnp.asarray(groups[g, i]) for i in range(n_i)]
+        ))
+        for g in range(n_o)
+    ])                                             # [n_o, d, lanes]
+    ref2 = np.asarray(functools.reduce(
+        jnp.add, [jnp.asarray(partials[g]) for g in range(n_o)]
+    ))                                             # outer member order
+    assert np.array_equal(h32, ref2)
+
+    h8 = np.asarray(wires.reduce_scatter_wire(
+        x, topo, ("dp", "fsdp"), "int8", hierarchical=True
+    )).reshape(d, lanes)
+    # inter-hop bound: each group's partial y quantizes once per block;
+    # the envelope sums every group's per-block bound elementwise (a
+    # strictly-larger bound than the exact per-final-block sum)
+    codec = wires.get_codec("int8")
+    env = np.zeros((n, d // n, lanes), np.float32)
+    for g in range(n_o):
+        y3 = jnp.asarray(partials[g]).reshape(n, d // n, lanes)
+        env += np.broadcast_to(np.asarray(codec.bound(y3)), env.shape)
+    assert (np.abs(h8 - ref2) <= env.reshape(d, lanes) + 1e-6).all()
+
+    # hierarchical all-gather: outer-major layout, fp32 bitwise
+    shards = jnp.asarray(contribs[:, :3])
+    hg = np.asarray(wires.all_gather_wire(
+        shards, topo, ("dp", "fsdp"), "fp32", hierarchical=True
+    ))
+    assert np.array_equal(hg, np.asarray(shards).reshape(n * 3, lanes))
+
+
+# ------------------------------------------------------------- engine level
+BASE = {
+    "train_batch_size": 16,
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+    "steps_per_print": 100,
+}
+DATA = {
+    "input_ids": np.random.RandomState(0).randint(0, 128, size=(16, 16))
+}
+
+
+def _run(zero, steps=3, dims=None):
+    comm.destroy_process_group()
+    kw = {}
+    if dims is not None:
+        topo = MeshTopology(dims)
+        comm.set_topology(topo)
+        kw["topology"] = topo
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+        config=dict(BASE, zero_optimization=zero),
+        rng=jax.random.PRNGKey(7),
+        **kw,
+    )
+    losses = [float(engine.train_batch(batch=DATA)) for _ in range(steps)]
+    streams = engine.analytic_streams()
+    params = engine.state.params
+    engine.destroy()
+    return losses, streams, params
+
+
+def test_stage2_grad_wire_trains_and_declares_stream(devices8):
+    dense, s_dense, _ = _run({"stage": 2})
+    wired, s_wired, params = _run({"stage": 2, "grad_wire": "int8"})
+    assert wired[-1] < wired[0]  # still learns
+    for a, b in zip(dense, wired):
+        assert abs(a - b) / abs(a) < 0.02, (dense, wired)
+    assert "grad_wire" not in s_dense
+    gw = s_wired["grad_wire"]
+    assert gw["bytes_per_step"] > 0 and gw["kind"] == "ici"
+    assert gw["codec"] == "int8" and not gw["overlapped"]
+    # f32 masters stay f32 through the wired update
+    assert all(
+        leaf.dtype == jnp.float32
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+
+
+def test_wire_spelling_is_bitwise_the_legacy_zeropp_path(devices8):
+    """grad_wire/param_wire int8 IS zero_quantized_* (same codecs, same
+    programs) — trajectories match bitwise."""
+    legacy, _, _ = _run({
+        "stage": 3, "stage3_param_persistence_threshold": 1,
+        "zero_quantized_weights": True, "zero_quantized_gradients": True,
+    })
+    wired, streams, _ = _run({
+        "stage": 3, "stage3_param_persistence_threshold": 1,
+        "grad_wire": "int8", "param_wire": "int8",
+    })
+    assert legacy == wired, (legacy, wired)
+    assert streams["grad_wire"]["bytes_per_step"] > 0
+    assert streams["param_wire"]["bytes_per_step"] > 0
+
+
+def test_prefetch_composes_with_param_wire(devices8):
+    """stage3_layer_prefetch + param_wire: the prefetched gather moves
+    codec bytes (the zero3_prefetch stream shrinks and carries the codec
+    name; the stacked layers are never double-counted in the wire
+    streams) and the engine still trains."""
+    full, s_full, _ = _run({
+        "stage": 3, "stage3_param_persistence_threshold": 1,
+        "stage3_layer_prefetch": True,
+    })
+    wired, s_wired, _ = _run({
+        "stage": 3, "stage3_param_persistence_threshold": 1,
+        "stage3_layer_prefetch": True,
+        "grad_wire": "int8", "param_wire": "int8",
+    })
+    assert wired[-1] < wired[0]
+    assert abs(wired[0] - full[0]) / abs(full[0]) < 0.02
+    z_full, z_wired = s_full["zero3_prefetch"], s_wired["zero3_prefetch"]
+    assert z_wired["param_wire"] == "int8"
+    assert z_wired["bytes_per_step"] < z_full["bytes_per_step"]
+    # non-layers leaves ride the wire streams; the stacked layers group
+    # is priced by zero3_prefetch only
+    nopf, s_nopf, _ = _run({
+        "stage": 3, "stage3_param_persistence_threshold": 1,
+        "grad_wire": "int8", "param_wire": "int8",
+    }, steps=1)
+    assert (s_wired["param_wire"]["bytes_per_step"]
+            < s_nopf["param_wire"]["bytes_per_step"])
+
+
+def test_hierarchical_wire_engine_runs_on_factored_mesh(devices8):
+    wired, streams, _ = _run(
+        {"stage": 2, "grad_wire": "int8", "hierarchical_wire": True},
+        dims=ParallelDims(dp=2, fsdp=4),
+    )
+    assert wired[-1] < wired[0]
+    gw = streams["grad_wire"]
+    assert gw["hierarchical"]
+    assert gw["intra_bytes_per_step"] > 0 and gw["inter_bytes_per_step"] > 0
+    # flat mesh: the knob logs + degrades to single hop
+    comm.destroy_process_group()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+        config=dict(BASE, zero_optimization={
+            "stage": 2, "grad_wire": "int8", "hierarchical_wire": True,
+        }),
+    )
+    assert engine._hier_wire is False
+    engine.destroy()
+
+
+def test_wired_engine_lints_clean_R5(devices8):
+    """The acceptance contract shardlint R5 keeps honest: an int8 grad
+    wire leaves ONE untruncated f32 path from master input to master
+    output (dequant-accumulate in f32). The abstract trace of the wired
+    step must carry no R5 findings."""
+    from deepspeed_tpu.analysis import lint_config
+
+    comm.destroy_process_group()
+    report = lint_config(
+        dict(BASE, zero_optimization={
+            "stage": 3, "stage3_param_persistence_threshold": 1,
+            "grad_wire": "int8", "param_wire": "int8",
+        }),
+        model=gpt2("gpt2-tiny", vocab_size=128, max_seq_len=16),
+        only=["R5"],
+        source="wired-engine",
+    )
+    assert not report.findings, [f.message for f in report.findings]
+
+
+# ------------------------------------------------------------------ config
+def test_config_validation_and_legacy_mapping():
+    with pytest.raises(DeepSpeedConfigError, match="grad_wire"):
+        DeepSpeedConfig(dict(BASE, zero_optimization={
+            "stage": 2, "grad_wire": "int3",
+        }))
+    with pytest.raises(DeepSpeedConfigError, match="stage 3"):
+        DeepSpeedConfig(dict(BASE, zero_optimization={
+            "stage": 2, "param_wire": "int8",
+        }))
+    with pytest.raises(DeepSpeedConfigError, match="stage >= 1"):
+        DeepSpeedConfig(dict(BASE, zero_optimization={
+            "stage": 0, "grad_wire": "bf16",
+        }))
+    zc = DeepSpeedConfig(dict(BASE, zero_optimization={
+        "stage": 3, "zero_quantized_weights": True,
+        "zero_quantized_gradients": True,
+    })).zero_config
+    assert zc.resolved_param_wire() == "int8"
+    assert zc.resolved_grad_wire() == "int8"
+    zc2 = DeepSpeedConfig(dict(BASE, zero_optimization={
+        "stage": 3, "grad_wire": "int4", "param_wire": "bf16",
+    })).zero_config
+    assert zc2.resolved_grad_wire() == "int4"
+    assert zc2.resolved_param_wire() == "bf16"
+
+
+# ----------------------------------------------------------------- planner
+def test_planner_wire_axis_prices_codecs(devices8):
+    """The wire-codec axis (stage x grad_wire x param_wire) reaches the
+    built candidate config and the abstract plan declares the wire
+    streams — priced before any compile."""
+    from deepspeed_tpu.autotuning import PlannerSearch
+
+    base = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 1},
+        "autotuning": {"max_train_micro_batch_size_per_gpu": 1,
+                       "tune_zero": False},
+    }
+    comm.destroy_process_group()
+    search = PlannerSearch(
+        gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16, hidden_size=32,
+             num_layers=2, num_heads=2),
+        base, None, top_k=1,
+    )
+    cands = search.candidates()
+    combos = {(c.grad_wire, c.param_wire) for c in cands}
+    assert combos == {
+        ("fp32", "fp32"), ("fp32", "int8"),
+        ("int8", "fp32"), ("int8", "int8"),
+    }
+    on = next(c for c in cands
+              if c.grad_wire == "int8" and c.param_wire == "int8"
+              and not c.z3_prefetch and c.remat == "none")
+    cfg = search._candidate_config(on)
+    assert cfg["zero_optimization"]["grad_wire"] == "int8"
+    assert cfg["zero_optimization"]["param_wire"] == "int8"
+    assert "gw-int8" in on.label() and "pw-int8" in on.label()
+    pc = search._plan_one(on)
+    assert pc.plan is not None, pc.reason
+    assert pc.plan.streams["grad_wire"]["bytes_per_step"] > 0
+    assert pc.plan.streams["param_wire"]["bytes_per_step"] > 0
