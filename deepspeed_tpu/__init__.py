@@ -38,3 +38,14 @@ def init_serving(model=None, serving=None, **kwargs):
     from .serving import ServingEngine
 
     return ServingEngine(model=model, serving=serving, **kwargs)
+
+
+def init_fleet(model=None, serving=None, **kwargs):
+    """Replicated serving tier front door: model + "serving" section
+    (with its "fleet" subsection) → :class:`~deepspeed_tpu.serving.fleet
+    .Router` over N data-parallel ServingEngine replicas — fleet
+    admission + load shedding, prefix-aware routing, session affinity,
+    optional prefill/decode disaggregation (docs/serving.md "Fleet")."""
+    from .serving.fleet import Router
+
+    return Router(model=model, serving=serving, **kwargs)

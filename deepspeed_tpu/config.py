@@ -383,6 +383,88 @@ class SpecDecodeConfig:
 
 
 @dataclass
+class FleetConfig:
+    """"serving.fleet" section — the disaggregated, replicated serving
+    tier (deepspeed_tpu/serving/fleet/, docs/serving.md "Fleet"). A
+    :class:`~deepspeed_tpu.serving.fleet.Router` owns a fleet-level
+    bounded admission gate and dispatches requests across ``replicas``
+    data-parallel ServingEngine replicas (one process, shared params),
+    with prefix-cache-aware routing over the chained-crc32 block keys,
+    optional DistServe-style prefill/decode disaggregation (dedicated
+    prefill replicas hand finished prefills' KV to decode replicas as a
+    page transfer), session affinity and load shedding. Correctness
+    anchor: ANY routing of a trace replays token-for-token equal to a
+    single-replica serial replay (the deterministic per-request RNG
+    chain), including across a prefill→decode handoff."""
+
+    enabled: bool = False
+    replicas: int = 2            # data-parallel ServingEngine replicas
+    prefill_replicas: int = 0    # of those, dedicated prefill replicas
+                                 # (0 = every replica serves mixed
+                                 # prefill+decode; > 0 needs serving.paged
+                                 # — the KV handoff is a page transfer)
+    routing: str = "prefix"      # prefix | least_loaded | round_robin
+                                 # ("prefix" routes to the replica whose
+                                 # PrefixCache holds the longest matching
+                                 # block chain, falling back to load)
+    affinity: bool = True        # session_id -> replica stickiness (a
+                                 # session's KV reuse stays local)
+    queue_limit: int = 0         # fleet-wide shed threshold: total queued
+                                 # across replicas at admission; 0 = only
+                                 # the per-replica bounds shed
+    shed_ttft_p95_s: float = 0.0  # shed new arrivals while the fleet's
+                                 # recent p95 TTFT exceeds this; 0 = off
+    prefix_balance_slack: int = -1  # cache-locality vs load-balance
+                                 # trade: a prefix match only wins while
+                                 # the matched replica's load exceeds the
+                                 # idlest replica's by at most this many
+                                 # requests (a fully-shared system prompt
+                                 # must not pile the whole fleet's
+                                 # traffic on one replica); -1 = auto
+                                 # (max(1, max_slots // 2))
+
+    ROUTING_POLICIES = ("prefix", "least_loaded", "round_robin")
+
+    def validate(self) -> None:
+        if int(self.replicas) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.fleet.replicas must be >= 1, got {self.replicas}"
+            )
+        if int(self.prefill_replicas) < 0:
+            raise DeepSpeedConfigError(
+                "serving.fleet.prefill_replicas must be >= 0, got "
+                f"{self.prefill_replicas}"
+            )
+        if int(self.prefill_replicas) >= int(self.replicas):
+            raise DeepSpeedConfigError(
+                f"serving.fleet.prefill_replicas {self.prefill_replicas} "
+                f"must be < replicas {self.replicas}: every prefill "
+                "replica hands its KV to a decode replica, so at least "
+                "one decode replica must exist"
+            )
+        if self.routing not in self.ROUTING_POLICIES:
+            raise DeepSpeedConfigError(
+                "serving.fleet.routing must be one of "
+                f"{'|'.join(self.ROUTING_POLICIES)}, got {self.routing!r}"
+            )
+        if int(self.queue_limit) < 0:
+            raise DeepSpeedConfigError(
+                "serving.fleet.queue_limit must be >= 0 (0 = per-replica "
+                f"bounds only), got {self.queue_limit}"
+            )
+        if float(self.shed_ttft_p95_s) < 0:
+            raise DeepSpeedConfigError(
+                "serving.fleet.shed_ttft_p95_s must be >= 0 (0 = off), "
+                f"got {self.shed_ttft_p95_s}"
+            )
+        if int(self.prefix_balance_slack) < -1:
+            raise DeepSpeedConfigError(
+                "serving.fleet.prefix_balance_slack must be >= -1 "
+                f"(-1 = auto), got {self.prefix_balance_slack}"
+            )
+
+
+@dataclass
 class ServingConfig:
     """"serving" section — the continuous-batching runtime
     (deepspeed_tpu/serving/). Parity: DeepSpeed-MII / FastGen's
@@ -416,13 +498,19 @@ class ServingConfig:
     spec: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
                                  # speculative decoding (draft-then-verify
                                  # per decode slot); see SpecDecodeConfig
+    fleet: FleetConfig = field(default_factory=FleetConfig)
+                                 # replicated serving tier behind a
+                                 # prefix-aware router; see FleetConfig
 
     def __post_init__(self):
-        # _parse_dc is shallow: the nested "spec" section arrives as a
-        # dict both from DeepSpeedConfig and from ServingEngine(serving=
-        # {...}) — normalize it here so every consumer sees the dataclass
+        # _parse_dc is shallow: the nested "spec"/"fleet" sections arrive
+        # as dicts both from DeepSpeedConfig and from ServingEngine(
+        # serving={...}) — normalize here so every consumer sees the
+        # dataclasses
         if isinstance(self.spec, dict):
             self.spec = _parse_dc(SpecDecodeConfig, self.spec)
+        if isinstance(self.fleet, dict):
+            self.fleet = _parse_dc(FleetConfig, self.fleet)
 
     def pages_per_slot(self, max_tokens: Optional[int] = None) -> int:
         """Logical pages per slot: covers the per-request token cap plus
@@ -476,6 +564,15 @@ class ServingConfig:
                     f"max_draft + 1 <= token_budget {self.token_budget}: a "
                     "spec decode slot's verify window is max_draft + 1 rows "
                     "of the one fixed-shape step"
+                )
+        if self.fleet.enabled:
+            self.fleet.validate()
+            if int(self.fleet.prefill_replicas) > 0 and not self.paged:
+                raise DeepSpeedConfigError(
+                    "serving.fleet.prefill_replicas > 0 requires "
+                    "serving.paged: the prefill→decode KV handoff is a "
+                    "page-table + page-payload transfer through the "
+                    "block-paged arena (docs/serving.md)"
                 )
         # NOTE: the num_pages liveness floor (num_pages >= pages_per_slot)
         # depends on the ENGINE-clamped max_tokens (min with the model's

@@ -9,16 +9,22 @@ the metrics glossary.
 
 from .engine import (ServingEngine, make_paged_step_fn, make_step_fn,
                      trace_serving_step)
-from .metrics import ServingMetrics
-from .paging import PagePool, PrefixCache
+from .fleet import GlobalPrefixIndex, ReplicaHandle, Router
+from .metrics import FleetMetrics, ServingMetrics
+from .paging import (PagePool, PrefixCache, chain_hashes, export_pages,
+                     import_pages)
 from .request import Request, RequestState, RequestStatus, request_rng
 from .scheduler import Scheduler, StepPlan
 from .spec import (clamp_advance_at_eos, longest_accepted_prefix,
                    ngram_propose, propose_drafts, verify_window)
 
 __all__ = [
+    "FleetMetrics",
+    "GlobalPrefixIndex",
     "PagePool",
     "PrefixCache",
+    "ReplicaHandle",
+    "Router",
     "Request",
     "RequestState",
     "RequestStatus",
@@ -26,7 +32,10 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "StepPlan",
+    "chain_hashes",
     "clamp_advance_at_eos",
+    "export_pages",
+    "import_pages",
     "longest_accepted_prefix",
     "make_paged_step_fn",
     "make_step_fn",

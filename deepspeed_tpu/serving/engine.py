@@ -309,6 +309,7 @@ class ServingEngine:
         comm_logger=None,
         steptrace=None,
         healthwatch=None,
+        name: Optional[str] = None,
         **engine_kwargs,
     ):
         from ..config import ServingConfig, _parse_dc
@@ -334,6 +335,10 @@ class ServingEngine:
         self.dtype = engine.dtype
         self.clock = clock
         self.comm_logger = comm_logger
+        # fleet identity: the router names each replica ("r0", "r1", ...)
+        # so the shared steptrace timeline's serve/step spans say which
+        # replica stepped; None = the single-engine path, no annotation
+        self.name = name
 
         N, W = serving.max_slots, serving.token_budget
         self.max_slots, self.token_budget = N, W
@@ -492,13 +497,18 @@ class ServingEngine:
             return step_fn(*args)
 
         self._step = jax.jit(counting_step, donate_argnums=(1, 2))
+        # lazily-jitted fleet-handoff page scatter (pool donated; one
+        # compile per distinct transferred-page count, bounded by
+        # pages_per_slot)
+        self._import_pages_fn = None
         arena = (
             f"pages={self.num_pages}x{self.page_size}tok "
             f"({self.pages_per_slot}/slot)"
             if self.paged else f"capacity={self.capacity}/slot"
         )
         log_dist(
-            f"ServingEngine: slots={N}, token_budget={W}, {arena}, kv="
+            f"ServingEngine{f'[{name}]' if name else ''}: "
+            f"slots={N}, token_budget={W}, {arena}, kv="
             f"{'int8' if engine.kv_cache_quantized else jnp.dtype(engine.kv_cache_storage_dtype).name}, "
             f"tp={self.topology.tp_size}, spec="
             f"{f'ngram(k<={self.max_draft})' if self.max_draft else 'off'}"
@@ -544,8 +554,10 @@ class ServingEngine:
         # traced step: serve/step parent; serve/plan, serve/dispatch,
         # serve/device, serve/complete children cover the whole of it
         # (tools/trace_report.py --validate checks the coverage)
-        step_sp = tr.begin("serve/step", "serve",
-                           {"step": self.metrics.steps + 1})
+        step_args = {"step": self.metrics.steps + 1}
+        if self.name is not None:
+            step_args["replica"] = self.name
+        step_sp = tr.begin("serve/step", "serve", step_args)
         plan_sp = tr.begin("serve/plan", "serve")
         plan = self.scheduler.plan()
         if plan is None:
@@ -649,6 +661,54 @@ class ServingEngine:
         if tr is not None:
             complete_sp.end()
         return finished
+
+    # ------------------------------------------------- fleet KV handoff
+    def export_kv_pages(self, page_ids) -> Dict[str, Any]:
+        """Snapshot the payload of physical ``page_ids`` out of this
+        replica's paged pool (serving/paging.py export_pages) — the
+        prefill half of the fleet's prefill→decode handoff."""
+        from .paging import export_pages
+
+        if not self.paged:
+            raise RuntimeError(
+                "export_kv_pages needs the paged arena (serving.paged) — "
+                "the fleet KV handoff is a page transfer"
+            )
+        return export_pages(self._caches, page_ids)
+
+    def import_kv_pages(self, payload: Dict[str, Any], dst_page_ids
+                        ) -> None:
+        """Scatter an exported payload into ``dst_page_ids`` of this
+        replica's pool. The scatter runs jitted with the pool DONATED,
+        so the update happens in place — O(pages moved), never an
+        O(arena) copy per handoff — and the result keeps exactly the
+        sharding the step compiled against (donated-buffer reuse), so
+        the import never buys a step recompile (the fleet oracle
+        asserts ``step_traces == 1`` per replica)."""
+        from .paging import check_page_payload, scatter_pages
+
+        if not self.paged:
+            raise RuntimeError(
+                "import_kv_pages needs the paged arena (serving.paged)"
+            )
+        ids = np.asarray(dst_page_ids, np.int32)
+        check_page_payload(self._caches, payload, ids.size)
+        if self._import_pages_fn is None:
+            self._import_pages_fn = jax.jit(
+                scatter_pages, donate_argnums=(0,)
+            )
+        caches = self._import_pages_fn(
+            self._caches, payload, jnp.asarray(ids)
+        )
+        if self._cache_shardings is not None:
+            # re-assert the tp sharding the step compiled against: the
+            # donated scatter USUALLY reuses the input buffers (keeping
+            # their placement), but nothing pins its output sharding —
+            # and a drifted carry would buy a step recompile. device_put
+            # onto an identical sharding is a no-op, so the in-place win
+            # survives whenever the layout did.
+            caches = jax.device_put(caches, self._cache_shardings)
+        self._caches = caches
 
     def run_until_idle(self, max_steps: int = 100_000
                        ) -> List[RequestState]:

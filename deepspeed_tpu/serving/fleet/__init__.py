@@ -1,0 +1,25 @@
+"""Fleet: a disaggregated, replicated serving tier (ISSUE 13).
+
+A :class:`Router` over N data-parallel ServingEngine replicas — fleet
+admission + load shedding, prefix-cache-aware routing over the
+chained-crc32 block keys (:class:`GlobalPrefixIndex`), session affinity,
+and DistServe-style prefill/decode disaggregation whose KV handoff is a
+page transfer (:func:`handoff`). See docs/serving.md "Fleet".
+"""
+
+from .handoff import handoff, pages_needed
+from .index import GlobalPrefixIndex
+from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL,
+                      ReplicaHandle)
+from .router import Router
+
+__all__ = [
+    "GlobalPrefixIndex",
+    "ROLE_DECODE",
+    "ROLE_MIXED",
+    "ROLE_PREFILL",
+    "ReplicaHandle",
+    "Router",
+    "handoff",
+    "pages_needed",
+]

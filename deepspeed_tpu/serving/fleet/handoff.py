@@ -1,0 +1,94 @@
+"""Prefill→decode KV handoff: a page transfer, not a tensor reshape.
+
+DistServe / DeepSpeed-MII-style disaggregation on top of the block-paged
+arena (serving/paging.py): when a dedicated prefill replica finishes a
+request's prefill (the final prompt feed sampled its first token), the
+slot's KV moves to a decode replica as
+
+  1. a **page-table read** — the logical pages covering the written
+     frontier (prompt + generated-but-last; the newest sampled token was
+     never fed, so its KV does not exist yet),
+  2. a **page-payload transfer** — ``export_pages`` snapshots those
+     physical pages out of the prefill pool, ``import_pages`` scatters
+     them into pages freshly allocated from the decode pool,
+  3. a **state adoption** — the RequestState (tokens, RNG chain,
+     draft tail) re-slots on the decode replica and continues decoding
+     exactly where a single-replica run would.
+
+Invariant (asserted after EVERY transfer, success or deferral): both
+pools satisfy ``free + live == num_pages`` and per-page refcounts match
+their holders. Deferral is graceful — when the destination lacks a free
+slot or enough pages (after LRU prefix-cache eviction), the request
+simply keeps decoding on the prefill replica; the router retries next
+tick. Determinism never depends on where a request decodes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..request import RequestState
+from .replica import ReplicaHandle
+
+
+def pages_needed(state: RequestState, page_size: int) -> int:
+    """Physical pages covering the written KV frontier: prompt + every
+    generated token except the newest (not fed yet, so never written)."""
+    frontier = state.prompt_len + max(len(state.tokens) - 1, 0)
+    return -(-frontier // int(page_size))
+
+
+def handoff(state: RequestState, src: ReplicaHandle, dst: ReplicaHandle,
+            ) -> Optional[int]:
+    """Move one DECODE-status request from ``src`` to ``dst``. Returns
+    the pages transferred, or None when the destination cannot take it
+    yet (no free slot / page pool exhausted even after LRU eviction) —
+    in which case NOTHING changed on either side."""
+    src_sched = src.engine.scheduler
+    dst_sched = dst.engine.scheduler
+    if not (src_sched.paged and dst_sched.paged):
+        raise RuntimeError("KV handoff needs paged arenas on both sides")
+    if src_sched.page_size != dst_sched.page_size:
+        raise RuntimeError(
+            f"page_size mismatch across replicas: {src_sched.page_size} "
+            f"vs {dst_sched.page_size}"
+        )
+    if state.slot is None or src_sched.slots[state.slot] is not state:
+        raise ValueError("handoff: state is not slotted on src")
+
+    need = pages_needed(state, src_sched.page_size)
+    if not dst_sched._free:
+        return None
+    dst_pages = dst_sched.alloc_pages(need)
+    if dst_pages is None:
+        # destination pool exhausted even after LRU eviction: defer.
+        # alloc_pages already rolled its partial allocation back, so the
+        # invariant holds on both sides — assert it anyway (the leak
+        # test forces exactly this path).
+        src_sched.assert_page_invariants()
+        dst_sched.assert_page_invariants()
+        return None
+
+    # payload snapshot BEFORE the src release: the physical ids are about
+    # to be decref'd (release may free them into the src pool)
+    src_pages = list(state.pages[:need])
+    payload = src.engine.export_kv_pages(src_pages)
+
+    # src side: publish the prompt KV to the src prefix cache (future
+    # prompts sharing the prefix skip their prefill — and the router's
+    # global index learns the chain), then recycle slot + references
+    src_sched.release(state.slot, insert_prefix=True)
+    state.slot = None
+
+    # dst side: scatter the payload and adopt. The imported pages hold
+    # byte-identical KV, the RNG chain rides in the state, and the
+    # adopted slot's first feed clears its stale seen row — so decoding
+    # continues bitwise where the single-replica replay would.
+    dst.engine.import_kv_pages(payload, dst_pages)
+    state.pages = list(dst_pages)
+    state.owned_from = 0
+    dst_sched.adopt(state)
+
+    src_sched.assert_page_invariants()
+    dst_sched.assert_page_invariants()
+    return need

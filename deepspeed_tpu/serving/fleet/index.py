@@ -1,0 +1,83 @@
+"""Global prefix index: which replica holds the longest block chain.
+
+The chained-crc32 page keys (serving/paging.py ``chain_hashes``) commit
+to the entire token prefix before them, which makes them GLOBALLY
+comparable: replica A and replica B holding the same key hold KV for the
+same prefix. The :class:`GlobalPrefixIndex` mirrors every replica's
+full-page chain keys — maintained push-style from each
+:class:`~deepspeed_tpu.serving.paging.PrefixCache`'s event listener, so
+routing never polls or locks a replica's cache — and scores a prompt per
+replica with the SAME longest-chain walk the replica-local
+``PrefixCache.longest_chain`` runs.
+
+Collisions: the index is hash-only, so a crc32 collision can over-score
+a replica. That mis-routes at worst — the chosen replica's token-verified
+``PrefixCache.match`` then degrades the hit to a miss, and the fleet
+oracle (any routing == serial replay, token-for-token) is unaffected.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..paging import PrefixCache, chain_hashes, longest_chain_walk
+
+
+class GlobalPrefixIndex:
+    """Per-replica mirrors of full-page chain keys + the scoring walk."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._hashes: Dict[int, Set[int]] = {}
+
+    def attach(self, replica_id: int, cache: PrefixCache) -> None:
+        """Subscribe to one replica's cache events. Attach happens at
+        fleet construction, before any request runs, so the mirror never
+        needs a catch-up replay; only FULL-page entries index (partial
+        tails shift routing by less than one page — not worth the
+        cross-replica bookkeeping)."""
+        if cache.page_size != self.page_size:
+            raise ValueError(
+                f"replica {replica_id} page_size {cache.page_size} != "
+                f"index page_size {self.page_size}: chain keys would not "
+                "be comparable across replicas"
+            )
+        mirror = self._hashes.setdefault(int(replica_id), set())
+
+        def listener(event: str, kind: str, h: int, page: int) -> None:
+            if kind != "full":
+                return
+            if event == "insert":
+                mirror.add(h)
+            else:
+                mirror.discard(h)
+
+        cache.listener = listener
+
+    def longest_chain(self, replica_id: int,
+                      token_block_hashes: Sequence[int]) -> int:
+        """Chain depth of ``token_block_hashes`` on one replica — the
+        same walk as ``PrefixCache.longest_chain``, over the mirror."""
+        mirror = self._hashes.get(int(replica_id), set())
+        return longest_chain_walk(token_block_hashes, mirror.__contains__)
+
+    def score(self, prompt, eligible: Sequence[int]
+              ) -> List[Tuple[int, int]]:
+        """(replica_id, chain_depth) for every eligible replica, prompt
+        hashed once."""
+        hashes = chain_hashes(prompt, self.page_size)
+        return [(rid, self.longest_chain(rid, hashes)) for rid in eligible]
+
+    def best(self, prompt, eligible: Sequence[int]
+             ) -> Tuple[Optional[int], int]:
+        """The eligible replica with the deepest chain match, or
+        (None, 0) when nothing matches anywhere (the router then falls
+        back to its load-based tie-break)."""
+        best_rid, best_depth = None, 0
+        for rid, depth in self.score(prompt, eligible):
+            if depth > best_depth:
+                best_rid, best_depth = rid, depth
+        return best_rid, best_depth
+
+    def entries(self, replica_id: int) -> int:
+        return len(self._hashes.get(int(replica_id), set()))

@@ -1,0 +1,481 @@
+"""Fleet router: one admission gate over N ServingEngine replicas.
+
+The millions-of-users tier (ROADMAP item 2, DistServe / DeepSpeed-MII
+parity): a :class:`Router` owns fleet-level admission and dispatches
+requests across ``serving.fleet.replicas`` data-parallel
+:class:`~deepspeed_tpu.serving.engine.ServingEngine` replicas — one
+process, shared params, each replica its own scheduler + KV arena +
+metrics. Routing is
+
+- **session affinity** first (``Request.session_id`` stickiness — a
+  session's prefix reuse stays local),
+- then **prefix-aware**: the replica whose PrefixCache holds the longest
+  matching block chain, looked up in the
+  :class:`~.index.GlobalPrefixIndex` (chained-crc32 keys mirrored from
+  replica cache events — no polling, no locks),
+- falling back to least-loaded (or round-robin / least-loaded as the
+  configured policy).
+
+**Load shedding** lifts the scheduler's bounded-queue semantics to fleet
+level: past ``fleet.queue_limit`` total queued (or while the recent
+fleet p95 TTFT exceeds ``fleet.shed_ttft_p95_s``) new arrivals are
+gracefully EVICTED with the same exponential ``retry_after`` backoff a
+replica's own bounded queue hands out. Replicas whose own queue is full
+are simply not routed to while any open replica exists.
+
+**Prefill/decode disaggregation** (``fleet.prefill_replicas > 0``):
+requests are routed to dedicated prefill replicas; once the final prompt
+feed samples a request's first token, the router moves its KV to a
+decode replica as a page transfer (serving/fleet/handoff.py) and the
+request continues decoding there — bitwise where a single replica would.
+
+The correctness anchor for all of it: ANY routing of a trace replays
+token-for-token equal to a single-replica serial replay (deterministic
+per-request RNG chains; tests/test_serving_fleet.py), with
+``step_traces == 1`` per replica.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ...utils.logging import log_dist
+from ..engine import ServingEngine
+from ..metrics import FleetMetrics, ServingMetrics, recent_percentile
+from ..request import Request, RequestState, RequestStatus
+from .handoff import handoff
+from .index import GlobalPrefixIndex
+from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, ReplicaHandle)
+
+
+class Router:
+    """Admission + routing + disaggregation over N serving replicas.
+
+    Drive it exactly like a ServingEngine: :meth:`submit` requests,
+    :meth:`step` ticks (one routing pass + one step on every replica
+    with work), :meth:`run_until_idle` drains. ``clock`` is injectable
+    and SHARED by every replica (virtual-clock replays stay coherent)."""
+
+    def __init__(
+        self,
+        model=None,
+        serving=None,
+        engine=None,
+        clock=time.monotonic,
+        comm_logger=None,
+        steptrace=None,
+        healthwatch=None,
+        **engine_kwargs,
+    ):
+        import dataclasses
+
+        from ...config import (FleetConfig, HealthwatchConfig,
+                               ServingConfig, _parse_dc)
+        from ...inference.engine import init_inference
+
+        if serving is None:
+            serving = ServingConfig()
+        elif isinstance(serving, dict):
+            serving = _parse_dc(ServingConfig, serving)
+        serving.validate()
+        fleet = serving.fleet
+        # constructing a Router IS opting into the fleet: validate the
+        # section even when "enabled" was left false in the raw config
+        fleet.validate()
+        if int(fleet.prefill_replicas) > 0 and not serving.paged:
+            from ...config import DeepSpeedConfigError
+
+            raise DeepSpeedConfigError(
+                "serving.fleet.prefill_replicas > 0 requires serving."
+                "paged: the prefill→decode KV handoff is a page transfer"
+            )
+        self.serving = serving
+        self.fleet = fleet
+        self.clock = clock
+
+        # healthwatch implies tracing (goodput classifies off serve/*
+        # spans) — resolve the sections BEFORE replicas are built so the
+        # replicas land on the shared registry
+        hwc = None
+        if healthwatch is not None:
+            hwc = (
+                healthwatch if isinstance(healthwatch, HealthwatchConfig)
+                else _parse_dc(HealthwatchConfig, healthwatch)
+            )
+            hwc.validate()
+            if hwc.enabled and steptrace is None:
+                steptrace = {"enabled": True}
+
+        # ---- the shared inference engine (params are read-only across
+        # replicas; each replica owns its own KV arena + scheduler) -----
+        if engine is None:
+            if model is None:
+                raise ValueError("Router needs a model or an engine")
+            if serving.kv_cache_dtype != "auto":
+                engine_kwargs.setdefault(
+                    "kv_cache_dtype", serving.kv_cache_dtype
+                )
+            engine_kwargs.setdefault("max_tokens", serving.max_tokens)
+            engine = init_inference(model, **engine_kwargs)
+        self.engine = engine
+
+        n = int(fleet.replicas)
+        k = int(fleet.prefill_replicas)
+        self.replicas: List[ReplicaHandle] = []
+        for i in range(n):
+            role = (
+                ROLE_PREFILL if i < k else (ROLE_DECODE if k else ROLE_MIXED)
+            )
+            # decode replicas never prefill, so a prefix cache there
+            # would only hold dead weight against the pool — disable it
+            rep_serving = dataclasses.replace(
+                serving,
+                fleet=FleetConfig(),
+                prefix_cache=bool(serving.prefix_cache)
+                and role != ROLE_DECODE,
+            )
+            srv = ServingEngine(
+                engine=engine,
+                serving=rep_serving,
+                clock=clock,
+                metrics=ServingMetrics(clock=clock),
+                comm_logger=comm_logger,
+                steptrace=steptrace,
+                name=f"r{i}",
+            )
+            self.replicas.append(ReplicaHandle(i, srv, role))
+        self._intake = [
+            r for r in self.replicas
+            if r.role in (ROLE_PREFILL, ROLE_MIXED)
+        ]
+        self._decode = [r for r in self.replicas if r.role == ROLE_DECODE]
+
+        # one ServeTracer across the fleet: a request's span tree crosses
+        # replicas on handoff (PREFILL opens on r0, DONE lands on r2) and
+        # the open-phase bookkeeping must follow it
+        self.tracer = self.replicas[0].engine.tracer
+        self._steptrace_export_path = \
+            self.replicas[0].engine._steptrace_export_path
+        if self.tracer is not None:
+            shared = self.replicas[0].engine._serve_tracer
+            for r in self.replicas[1:]:
+                r.engine._serve_tracer = shared
+                r.engine.metrics.tracer = shared
+
+        # ---- the global prefix index (paged + prefix-cache mode) -------
+        self.index: Optional[GlobalPrefixIndex] = None
+        if serving.paged and serving.prefix_cache:
+            self.index = GlobalPrefixIndex(int(serving.page_size))
+            for r in self._intake:
+                self.index.attach(
+                    r.replica_id, r.engine.scheduler.prefix_cache
+                )
+
+        self.metrics = FleetMetrics(
+            [r.engine.metrics for r in self.replicas], clock=clock
+        )
+        self._sessions: Dict[str, int] = {}   # session_id -> replica_id
+        self._rr = 0                          # round-robin cursor
+        self.last_tick_durations: Dict[int, float] = {}
+        self.last_tick_overhead_s = 0.0
+
+        # ---- fleet-level healthwatch: the queue/TTFT watchdogs read the
+        # AGGREGATED metrics, so breaches are fleet facts ----------------
+        self.healthwatch = None
+        if hwc is not None and hwc.enabled:
+            from ...profiling import healthwatch as _healthwatch
+
+            self.healthwatch = _healthwatch.HealthWatch(
+                hwc, self.tracer, source="serve",
+                context={"config": {"serving": {
+                    "max_slots": int(serving.max_slots),
+                    "token_budget": int(serving.token_budget),
+                    "paged": bool(serving.paged),
+                    "fleet": {
+                        "replicas": n, "prefill_replicas": k,
+                        "routing": fleet.routing,
+                        "affinity": bool(fleet.affinity),
+                        "queue_limit": int(fleet.queue_limit),
+                    },
+                }}},
+            )
+
+        log_dist(
+            f"fleet Router: {n} replicas ({k} prefill), routing="
+            f"{fleet.routing}, affinity={bool(fleet.affinity)}, "
+            f"queue_limit={int(fleet.queue_limit) or 'per-replica'}"
+        )
+
+    # ------------------------------------------------------------ intake
+    def submit(self, request: Request) -> RequestState:
+        """Route one request (or shed it gracefully). Always returns the
+        state; EVICTED means shed/rejected with ``retry_after`` set."""
+        now = self.clock()
+        reason = self._shed_reason()
+        if reason is not None:
+            state = RequestState(request=request, arrival_t=now)
+            state.attempts = 1
+            return self._shed(state, now, reason)
+        rep, via = self._route(request)
+        state = rep.engine.submit(request)
+        self._record_route(request, rep, via, state)
+        return state
+
+    def resubmit(self, state: RequestState) -> RequestState:
+        """Retry an evicted request (router-shed or replica-evicted) —
+        the fleet twin of Scheduler.resubmit; re-routes from scratch."""
+        if state.status is not RequestStatus.EVICTED:
+            raise ValueError(
+                f"resubmit needs an EVICTED state, got {state.status.value}"
+            )
+        now = self.clock()
+        reason = self._shed_reason()
+        if reason is not None:
+            state.attempts += 1
+            return self._shed(state, now, reason, already_evicted=True)
+        rep, via = self._route(state.request)
+        out = rep.engine.scheduler.resubmit(state)
+        self._record_route(state.request, rep, via, out)
+        return out
+
+    def _shed(self, state: RequestState, now: float, reason: str,
+              already_evicted: bool = False) -> RequestState:
+        """Fleet-level graceful rejection: the scheduler's bounded-queue
+        semantics (EVICTED + exponential retry_after) lifted up a tier."""
+        if not already_evicted:
+            state.transition(RequestStatus.EVICTED)
+        state.retry_after = now + float(self.serving.eviction_backoff_s) * (
+            2 ** max(state.attempts - 1, 0)
+        )
+        state.evict_reason = reason
+        state.finish_t = now
+        self.metrics.on_shed(reason)
+        log_dist(f"fleet: shed {state.request.request_id}: {reason}")
+        return state
+
+    def _shed_reason(self) -> Optional[str]:
+        ql = int(self.fleet.queue_limit)
+        # the LIVE depth (scheduler queues), not the metrics gauge — the
+        # gauge snapshots at hook time and lags the current arrival
+        depth = sum(r.queue_depth for r in self.replicas)
+        if ql and depth >= ql:
+            return f"fleet queue full ({depth} >= {ql})"
+        thr = float(self.fleet.shed_ttft_p95_s)
+        if thr > 0:
+            p95 = recent_percentile(self.metrics.ttft_s, 95)
+            if p95 is not None and p95 > thr:
+                return f"fleet ttft p95 {p95:.3f}s > {thr:.3f}s"
+        return None
+
+    # ------------------------------------------------------------ routing
+    def _open(self, reps: List[ReplicaHandle]) -> List[ReplicaHandle]:
+        """Replicas whose own bounded queue still admits; when every one
+        is full, all stay candidates — the chosen replica's scheduler
+        rejects with its own retry_after (the graceful path)."""
+        ql = int(self.serving.queue_limit)
+        if not ql:
+            return reps
+        open_ = [r for r in reps if r.queue_depth < ql]
+        return open_ or reps
+
+    def _route(self, request: Request):
+        """(replica, via) for one request. Precedence: session affinity →
+        prefix-aware (the configured policy) → load/round-robin."""
+        pool = self._open(self._intake)
+        by_id = {r.replica_id: r for r in pool}
+        sid = request.session_id
+        if self.fleet.affinity and sid is not None \
+                and sid in self._sessions and self._sessions[sid] in by_id:
+            return by_id[self._sessions[sid]], "affinity"
+        if self.fleet.routing == "prefix" and self.index is not None:
+            rid, depth = self.index.best(
+                request.prompt, list(by_id.keys())
+            )
+            if rid is not None and depth > 0:
+                # cache locality vs balance: a prefix hit saves at most
+                # the matched prefill, so it only wins while the matched
+                # replica isn't meaningfully busier than the idlest one —
+                # a fully-shared system prompt must not serialize the
+                # whole fleet onto one replica (every replica's cache
+                # learns the hot prefix within a few requests anyway)
+                slack = int(self.fleet.prefix_balance_slack)
+                if slack < 0:
+                    slack = max(1, int(self.serving.max_slots) // 2)
+                min_load = min(r.load for r in pool)
+                if by_id[rid].load - min_load <= slack:
+                    return by_id[rid], "prefix"
+        if self.fleet.routing == "round_robin":
+            rep = pool[self._rr % len(pool)]
+            self._rr += 1
+            return rep, "round_robin"
+        rep = min(pool, key=lambda r: (r.load, r.replica_id))
+        return rep, "least_loaded"
+
+    def _record_route(self, request: Request, rep: ReplicaHandle,
+                      via: str, state: RequestState) -> None:
+        if state.status is RequestStatus.EVICTED:
+            # the replica's own bounded queue rejected — its retry_after
+            # semantics carry through; count it as a fleet shed too
+            self.metrics.on_shed("replica queue full")
+            return
+        self.metrics.on_route(via)
+        if request.session_id is not None:
+            self._sessions[request.session_id] = rep.replica_id
+
+    # ----------------------------------------------------------- stepping
+    @property
+    def has_work(self) -> bool:
+        return any(r.has_work for r in self.replicas)
+
+    @property
+    def step_traces(self) -> List[int]:
+        """Per-replica step-trace counters (zero-recompiles criterion:
+        every stepped replica shows exactly 1)."""
+        return [r.engine.step_traces for r in self.replicas]
+
+    def step(self) -> List[RequestState]:
+        """One fleet tick: attempted prefill→decode handoffs, then one
+        engine step on every replica with work (data-parallel replicas —
+        a real deployment runs them concurrently, so the tick's latency
+        model is router overhead + max over replica step times, which is
+        what ``last_tick_durations``/``last_tick_overhead_s`` report).
+        Returns every request that finished this tick."""
+        if not self.has_work:
+            return []
+        hw = self.healthwatch
+        if hw is not None:
+            hw.on_step_start()
+        traces_before = sum(self.step_traces)
+        t0 = time.perf_counter()
+        tr = self.tracer
+        if tr is None:
+            self._run_handoffs()
+            finished = self._step_replicas()
+        else:
+            tick_sp = tr.begin("fleet/tick", "fleet",
+                               {"tick": self.metrics.ticks + 1})
+            route_sp = tr.begin("fleet/route", "fleet")
+            moved = self._run_handoffs()
+            if moved:
+                route_sp.annotate(handoffs=moved)
+            route_sp.end()
+            rep_sp = tr.begin("fleet/replicas", "fleet")
+            finished = self._step_replicas()
+            rep_sp.annotate(stepped=len(self.last_tick_durations))
+            rep_sp.end()
+            tick_sp.end()
+        self.last_tick_overhead_s = max(
+            time.perf_counter() - t0 - sum(
+                self.last_tick_durations.values()
+            ),
+            0.0,
+        )
+        if self.last_tick_durations:
+            self.metrics.on_tick()
+            if hw is not None:
+                hw.on_serve_step(
+                    step=self.metrics.ticks, metrics=self.metrics,
+                    compiled=sum(self.step_traces) - traces_before,
+                )
+        return finished
+
+    def _step_replicas(self) -> List[RequestState]:
+        finished: List[RequestState] = []
+        durs: Dict[int, float] = {}
+        for r in self.replicas:
+            if not r.has_work:
+                continue
+            fin, dur = r.step()
+            finished.extend(fin)
+            durs[r.replica_id] = dur
+        self.last_tick_durations = durs
+        for st in finished:
+            # fleet completion-order TTFT window (shed gate + watchdog)
+            if st.first_token_t is not None:
+                self.metrics.on_finish_ttft(
+                    st.first_token_t - st.arrival_t
+                )
+        return finished
+
+    def _run_handoffs(self) -> int:
+        """Move every eligible finished-prefill request from the prefill
+        replicas to the least-loaded decode replica that can take it.
+        Deferred transfers (no slot / no pages) stay put — the request
+        keeps decoding on its prefill replica and the router retries next
+        tick; correctness never depends on placement."""
+        if not self._decode:
+            return 0
+        moved = 0
+        for src in self.replicas:
+            if src.role != ROLE_PREFILL:
+                continue
+            for state in src.decode_candidates():
+                targets = sorted(
+                    (d for d in self._decode if d.has_free_slot),
+                    key=lambda d: (d.load, d.replica_id),
+                )
+                done = False
+                for dst in targets:
+                    pages = handoff(state, src, dst)
+                    if pages is not None:
+                        self.metrics.on_handoff(True, pages=pages)
+                        moved += 1
+                        done = True
+                        break
+                if not done:
+                    self.metrics.on_handoff(False)
+        return moved
+
+    def run_until_idle(self, max_steps: int = 100_000
+                       ) -> List[RequestState]:
+        """Drain every replica; returns every request finished on the
+        way (fleet completion order)."""
+        finished: List[RequestState] = []
+        steps = 0
+        while self.has_work:
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain within {max_steps} ticks"
+                )
+            finished.extend(self.step())
+            steps += 1
+        return finished
+
+    # --------------------------------------------------------- steptrace
+    def trace_export(self, path: Optional[str] = None) -> str:
+        """Export the AGGREGATED fleet trace: every replica's serve/step
+        spans and request trees already share the one registry timeline;
+        this adds each replica's analytic streams as ``plan/r<i>/...``
+        spans (per-replica predicted bytes/seconds next to the fleet's
+        measured mean step) before writing the Chrome trace JSON."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "steptrace is not enabled on this Router — pass "
+                'steptrace={"enabled": True} at construction'
+            )
+        measured = self.tracer.mean_dur("serve/step")
+        for r in self.replicas:
+            for name, stream in r.engine.analytic_streams().items():
+                self.tracer.plan_span(
+                    f"r{r.replica_id}/{name}", stream,
+                    measured_step_s=measured,
+                )
+        path = path or self._steptrace_export_path or "steptrace_fleet.json"
+        out = self.tracer.export(path)
+        log_dist(f"steptrace: wrote fleet trace {out}")
+        return out
+
+    # --------------------------------------------------- planner metadata
+    def analytic_streams(self, include_potential: bool = False
+                         ) -> Dict[str, Any]:
+        """Fleet streams: each replica's declared streams under an
+        ``r<i>/`` prefix (one schema with the single-engine form, so the
+        comm_logger / planner intakes need no fleet special case)."""
+        out: Dict[str, Any] = {}
+        for r in self.replicas:
+            for name, stream in r.engine.analytic_streams(
+                include_potential=include_potential
+            ).items():
+                out[f"r{r.replica_id}/{name}"] = stream
+        return out

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import math
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Optional
 
 
@@ -347,5 +347,187 @@ class ServingMetrics:
 
         write_events(monitor, [
             (f"serve/{k}", float(v), int(step))
+            for k, v in self.snapshot().items()
+        ])
+
+
+class FleetMetrics:
+    """Aggregate view over a fleet's per-replica :class:`ServingMetrics`
+    plus the router's own counters (serving/fleet/router.py). Counters
+    sum across replicas; latency percentiles merge the per-replica sample
+    lists (a request's TTFT is a fleet-level fact — it does not matter
+    which replica served it); gauges that are depths sum, ratios average
+    over replicas. Duck-types the two attributes the healthwatch serving
+    watchdogs read (``queue_depth``, ``ttft_s``), so the queue/TTFT rules
+    evaluate FLEET-wide when the router owns the healthwatch.
+
+    Exported under the ``serve/fleet/*`` namespace (per-replica metrics
+    keep ``serve/*`` on their own engines) — docs/observability.md."""
+
+    # replica counters that sum into the fleet snapshot
+    _SUM_KEYS = (
+        "submitted", "admitted", "rejected", "evicted", "finished",
+        "steps", "tokens_out", "scheduled_tokens", "prefix_hits",
+        "cached_prompt_tokens", "cow_copies", "prefill_chunks",
+        "cached_tail_feeds", "spec_steps", "draft_tokens_proposed",
+        "draft_tokens_accepted", "pages_in_use",
+    )
+
+    def __init__(self, replica_metrics: List["ServingMetrics"],
+                 clock=time.monotonic):
+        self.replicas = list(replica_metrics)
+        self.clock = clock
+        self._t0 = clock()
+        # router counters (fed by Router, not by replicas)
+        self.routed = 0             # requests dispatched to a replica
+        self.shed = 0               # fleet-level graceful rejections
+        self.shed_reasons: Dict[str, int] = defaultdict(int)
+        self.handoffs = 0           # completed prefill→decode transfers
+        self.handoff_failures = 0   # attempts deferred (no slot/pages)
+        self.handoff_pages = 0      # pages moved across pools
+        self.affinity_routed = 0    # routed by session stickiness
+        self.prefix_routed = 0      # routed by a non-zero chain match
+        self.ticks = 0              # router ticks that stepped >= 1 replica
+        # fleet-level TTFT samples in true COMPLETION order (the router
+        # appends as requests finish, whichever replica served them) —
+        # bounded, because its only consumers are recent-window reads:
+        # the shed_ttft_p95_s gate and the ttft_breach watchdog. A
+        # replica-order concatenation of the full per-replica lists
+        # would make a trailing window read mostly the LAST replica's
+        # history (and cost O(total requests) per submit).
+        self.recent_ttft_s: "deque[float]" = deque(maxlen=256)
+
+    # ------------------------------------------------------ router hooks
+    def on_route(self, via: str) -> None:
+        self.routed += 1
+        if via == "affinity":
+            self.affinity_routed += 1
+        elif via == "prefix":
+            self.prefix_routed += 1
+
+    def on_shed(self, reason: str) -> None:
+        self.shed += 1
+        self.shed_reasons[reason] += 1
+
+    def on_handoff(self, ok: bool, pages: int = 0) -> None:
+        if ok:
+            self.handoffs += 1
+            self.handoff_pages += int(pages)
+        else:
+            self.handoff_failures += 1
+
+    def on_tick(self) -> None:
+        self.ticks += 1
+
+    def on_finish_ttft(self, ttft_s: float) -> None:
+        """One finished request's TTFT, appended by the router in fleet
+        completion order."""
+        self.recent_ttft_s.append(float(ttft_s))
+
+    # ----------------------------------------- healthwatch duck-typing
+    @property
+    def queue_depth(self) -> int:
+        """Fleet queue depth: requests admitted but not yet slotted,
+        summed across replicas (the queue_depth_breach watchdog input)."""
+        return sum(int(m.queue_depth) for m in self.replicas)
+
+    @property
+    def ttft_s(self) -> List[float]:
+        """Recent TTFT samples in fleet COMPLETION order (bounded) — the
+        ttft_breach watchdog's recent-window input. All-time percentiles
+        live in :meth:`snapshot`, which merges the full per-replica
+        lists."""
+        return list(self.recent_ttft_s)
+
+    # ------------------------------------------------------ reporting
+    @property
+    def elapsed(self) -> float:
+        return self.clock() - self._t0
+
+    def tokens_per_s(self) -> float:
+        total = sum(m.tokens_out for m in self.replicas)
+        dur = self.elapsed
+        return total / dur if dur > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        snap: Dict[str, float] = {
+            k: sum(getattr(m, k) for m in self.replicas)
+            for k in self._SUM_KEYS
+        }
+        ttft: List[float] = []
+        tpot: List[float] = []
+        qwait: List[float] = []
+        for m in self.replicas:
+            ttft.extend(m.ttft_s)
+            tpot.extend(m.tpot_s)
+            qwait.extend(m.queue_wait_s)
+        snap.update({
+            "replicas": len(self.replicas),
+            "queue_depth": self.queue_depth,
+            "slot_occupancy": (
+                sum(m.slot_occupancy for m in self.replicas)
+                / max(len(self.replicas), 1)
+            ),
+            "tokens_per_s": self.tokens_per_s(),
+            "ttft_p50_s": percentile(ttft, 50),
+            "ttft_p95_s": percentile(ttft, 95),
+            "tpot_p50_s": percentile(tpot, 50),
+            "tpot_p95_s": percentile(tpot, 95),
+            "queue_wait_p95_s": percentile(qwait, 95),
+            "routed": self.routed,
+            "shed": self.shed,
+            "handoffs": self.handoffs,
+            "handoff_failures": self.handoff_failures,
+            "handoff_pages": self.handoff_pages,
+            "affinity_routed": self.affinity_routed,
+            "prefix_routed": self.prefix_routed,
+            "ticks": self.ticks,
+        })
+        return {k: _finite(v) for k, v in snap.items()}
+
+    def per_replica(self) -> List[Dict[str, float]]:
+        """The un-aggregated view: one ServingMetrics snapshot per
+        replica, in replica order."""
+        return [m.snapshot() for m in self.replicas]
+
+    def summary(self) -> str:
+        s = self.snapshot()
+        lines = [
+            f"fleet metrics ({len(self.replicas)} replicas)",
+            f"{'requests':<18}submitted={s['submitted']} "
+            f"routed={self.routed} finished={s['finished']} "
+            f"shed={self.shed} evicted={s['evicted']}",
+            f"{'throughput':<18}{s['tokens_per_s']:.1f} tok/s over "
+            f"{self.elapsed:.2f}s ({s['steps']} replica steps, "
+            f"{self.ticks} router ticks)",
+            f"{'ttft':<18}p50={s['ttft_p50_s'] * 1e3:.1f}ms "
+            f"p95={s['ttft_p95_s'] * 1e3:.1f}ms",
+            f"{'tpot':<18}p50={s['tpot_p50_s'] * 1e3:.1f}ms "
+            f"p95={s['tpot_p95_s'] * 1e3:.1f}ms",
+            f"{'routing':<18}affinity={self.affinity_routed} "
+            f"prefix={self.prefix_routed} "
+            f"handoffs={self.handoffs} "
+            f"(+{self.handoff_failures} deferred, "
+            f"{self.handoff_pages} pages moved)",
+        ]
+        per_rep = " ".join(
+            f"r{i}={m.tokens_out}" for i, m in enumerate(self.replicas)
+        )
+        lines.append(f"{'tokens by replica':<18}{per_rep}")
+        if self.shed_reasons:
+            reasons = ", ".join(
+                f"{k}: {v}" for k, v in sorted(self.shed_reasons.items())
+            )
+            lines.append(f"{'shed':<18}{reasons}")
+        return "\n".join(lines)
+
+    def write_to(self, monitor, step: int) -> None:
+        """Fleet aggregates under ``serve/fleet/*`` through the one
+        write_events bridge; each replica's own engine keeps writing its
+        ``serve/*`` series (docs/observability.md, "Fleet namespace")."""
+        from ..profiling.steptrace import write_events
+
+        write_events(monitor, [
+            (f"serve/fleet/{k}", float(v), int(step))
             for k, v in self.snapshot().items()
         ])

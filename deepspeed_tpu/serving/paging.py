@@ -1,9 +1,12 @@
 """Host-side KV page management: pool allocator + prefix cache.
 
 Parity: vLLM's PagedAttention block manager / DeepSpeed-FastGen's blocked
-KV cache, host-side only. The device never sees this module — the jitted
-serving step consumes the *result* (per-slot page-table int32 vectors and
-an optional copy-on-write source vector) and keeps its ONE fixed shape.
+KV cache, host-side only. The jitted serving step never sees this module
+— it consumes the *result* (per-slot page-table int32 vectors and an
+optional copy-on-write source vector) and keeps its ONE fixed shape.
+The only device-touching functions here are :func:`export_pages` /
+:func:`import_pages`, the eager page-payload transfer the fleet's
+prefill→decode KV handoff runs BETWEEN steps (serving/fleet/handoff.py).
 
 - :class:`PagePool` — refcounted free-list over ``num_pages`` physical
   page ids. A page is *live* while any slot or prefix-cache entry holds a
@@ -37,6 +40,104 @@ def chain_hash(prev: int, block) -> int:
     link, so a page's key commits to the ENTIRE prefix before it (KV at a
     position depends on every earlier token)."""
     return zlib.crc32(np.asarray(block, np.int32).tobytes(), prev)
+
+
+def chain_hashes(tokens, page_size: int) -> List[int]:
+    """The chained hash of every FULL page-sized block of ``tokens``, in
+    order. Because each link commits to the whole prefix before it, these
+    keys are globally comparable: two caches (on two replicas) holding the
+    same chain hash hold KV for the same token prefix — modulo crc32
+    collisions, which every consumer must let degrade to misses (the
+    router's index may mis-route on one; the replica's token-verified
+    ``match`` then treats it as a miss, never as wrong KV)."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    ps = int(page_size)
+    out: List[int] = []
+    h = 0
+    for i in range(toks.size // ps):
+        h = chain_hash(h, toks[i * ps: (i + 1) * ps])
+        out.append(h)
+    return out
+
+
+def longest_chain_walk(token_block_hashes, contains) -> int:
+    """The ONE definition of "longest matching block chain": the length of
+    the leading run of ``token_block_hashes`` for which ``contains(hash)``
+    holds. Shared by :meth:`PrefixCache.longest_chain` (the replica-local
+    cache view) and the fleet router's :class:`GlobalPrefixIndex` (the
+    event-maintained cross-replica mirror), so routing and matching agree
+    on what "longest chain" means. Accepts any iterable and consumes only
+    up to the first miss — ``match`` feeds it a lazy hash generator, so a
+    cold cache never pays for hashing a whole long prompt. Hash-presence
+    only — callers that hand out KV must still verify token equality."""
+    n = 0
+    for h in token_block_hashes:
+        if not contains(h):
+            break
+        n += 1
+    return n
+
+
+# ------------------------------------------------------- page payload I/O
+def export_pages(cache: Dict[str, "object"], page_ids: Sequence[int]
+                 ) -> Dict[str, "object"]:
+    """Gather the payload of physical ``page_ids`` out of a paged KV pool
+    (``init_paged_cache`` layout: the page axis is axis 1 of every leaf,
+    scales included). Returns ``{leaf: [L, n_pages, ...]}`` device arrays
+    — an immutable snapshot (the pool is updated functionally by the
+    step, so later steps can never mutate an exported payload). This is
+    the prefill half of the fleet's prefill→decode KV handoff: a page
+    TRANSFER, not a tensor reshape."""
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(np.asarray(page_ids, np.int32))
+    return {k: jnp.take(v, ids, axis=1) for k, v in cache.items()}
+
+
+def check_page_payload(cache: Dict[str, "object"],
+                       payload: Dict[str, "object"], n_pages: int) -> None:
+    """Validate an :func:`export_pages` payload against a destination
+    pool: every leaf present, ``n_pages`` wide, page geometry matching."""
+    for k, v in cache.items():
+        if k not in payload:
+            raise KeyError(f"import_pages: payload missing leaf {k!r}")
+        p = payload[k]
+        if p.shape[1] != n_pages or p.shape[0] != v.shape[0] \
+                or p.shape[2:] != v.shape[2:]:
+            raise ValueError(
+                f"import_pages: payload {k} shape {p.shape} does not fit "
+                f"{n_pages} pages of a pool leaf shaped {v.shape}"
+            )
+
+
+def scatter_pages(cache: Dict[str, "object"],
+                  payload: Dict[str, "object"],
+                  ids) -> Dict[str, "object"]:
+    """The traceable scatter core of :func:`import_pages`. The serving
+    engine jits this with the pool DONATED (import_kv_pages), so a
+    handoff updates the destination arena in place — O(pages moved), not
+    an O(arena) copy per transfer."""
+    return {
+        k: v.at[:, ids].set(payload[k].astype(v.dtype))
+        for k, v in cache.items()
+    }
+
+
+def import_pages(cache: Dict[str, "object"], payload: Dict[str, "object"],
+                 dst_page_ids: Sequence[int]) -> Dict[str, "object"]:
+    """Scatter an :func:`export_pages` payload into ``dst_page_ids`` of a
+    (possibly different) pool with the same page geometry. Returns the new
+    pool dict; the caller owns re-asserting device placement/sharding
+    (ServingEngine.import_kv_pages does, so the jitted step's donated
+    carry keeps the layout it compiled against). Host-side refcounts of
+    the destination pages are the destination scheduler's business —
+    the leak invariant ``free + live == num_pages`` must hold on BOTH
+    pools after every transfer (asserted by the fleet handoff)."""
+    import jax.numpy as jnp
+
+    ids = np.asarray(dst_page_ids, np.int32)
+    check_page_payload(cache, payload, ids.size)
+    return scatter_pages(cache, payload, jnp.asarray(ids))
 
 
 class PagePool:
@@ -118,6 +219,16 @@ class PrefixCache:
         )
         self._tails: Dict[int, List[Tuple[Tuple[int, ...], int]]] = {}
         self._lru: "OrderedDict[Tuple, None]" = OrderedDict()
+        # cache-event listener: ``listener(event, kind, chain_hash, page)``
+        # with event in {"insert", "evict"} and kind in {"full", "tail"}.
+        # The fleet router's GlobalPrefixIndex subscribes here to mirror
+        # each replica's full-page chain keys without polling; None (the
+        # default) is the zero-overhead single-engine path.
+        self.listener = None
+
+    def _emit(self, event: str, kind: str, h: int, page: int) -> None:
+        if self.listener is not None:
+            self.listener(event, kind, h, page)
 
     def __len__(self) -> int:
         return len(self._lru)
@@ -127,21 +238,48 @@ class PrefixCache:
         return [key[2] for key in self._lru]
 
     # ---------------------------------------------------------------- match
+    def longest_chain(self, token_block_hashes) -> int:
+        """Public longest-matching-block-chain lookup: how many leading
+        chained-crc32 FULL-page keys (:func:`chain_hashes`, or any lazy
+        iterable of them — only the matched prefix is ever consumed) this
+        cache holds. Hash-presence only — a crc32 collision can overstate
+        the depth, which is exactly why :meth:`match` re-verifies token
+        equality before handing out pages (collisions degrade to misses,
+        never to wrong KV). Used by the scheduler's match path and by the
+        fleet router's global index (the same :func:`longest_chain_walk`
+        over its event-maintained per-replica mirror)."""
+        return longest_chain_walk(token_block_hashes,
+                                  self._full.__contains__)
+
     def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
         """Longest cached prefix of ``prompt``: (pages, covered_tokens).
         Pages are NOT incref'd — the caller takes references for the ones
-        it keeps. Token equality is verified block-for-block."""
+        it keeps. The hash walk is :meth:`longest_chain` over a LAZY
+        chain-hash generator (a miss at block i stops hashing — a cold
+        cache costs one crc32, not one per prompt page); token equality
+        is then verified block-for-block (hash collisions shrink the
+        match — a miss, never wrong KV)."""
         toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
         ps = self.page_size
+        hashes: List[int] = []
+
+        def lazy_hashes():
+            h = 0
+            for i in range(len(toks) // ps):
+                h = chain_hash(h, toks[i * ps: (i + 1) * ps])
+                hashes.append(h)
+                yield h
+
+        depth = self.longest_chain(lazy_hashes())
         pages: List[int] = []
         covered = 0
         h = 0
-        while covered + ps <= len(toks):
+        for i in range(depth):
             block = tuple(toks[covered: covered + ps])
-            nh = chain_hash(h, block)
-            entry = self._full.get(nh)
-            if entry is None or entry[1] != block:
-                break
+            nh = hashes[i]
+            entry = self._full[nh]
+            if entry[1] != block:
+                break  # crc32 collision: stop the walk — a miss
             pages.append(entry[0])
             self._lru.move_to_end(("full", nh, entry[0], block))
             covered += ps
@@ -186,6 +324,7 @@ class PrefixCache:
                 self._full[nh] = (int(pages[i]), block)
                 self._lru[("full", nh, int(pages[i]), block)] = None
                 self.pool.incref(int(pages[i]))
+                self._emit("insert", "full", nh, int(pages[i]))
                 inserted += 1
             # ALSO register the full page's run for partial matching: a
             # prompt diverging mid-page (the shared-system-prompt shape)
@@ -205,6 +344,7 @@ class PrefixCache:
         runs.append((run, page))
         self._lru[("tail", h, page, run)] = None
         self.pool.incref(page)
+        self._emit("insert", "tail", h, page)
         return 1
 
     # --------------------------------------------------------------- evict
@@ -223,6 +363,7 @@ class PrefixCache:
             if not self._tails[h]:
                 del self._tails[h]
         self.pool.decref(page)
+        self._emit("evict", kind, h, page)
         return True
 
     def clear(self) -> None:

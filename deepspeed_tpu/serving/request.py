@@ -66,6 +66,10 @@ class Request:
     repetition_penalty: float = 1.0
     eos_token_id: int = -1
     rng: Optional[jax.Array] = None  # default: request_rng(request_id)
+    session_id: Optional[str] = None  # fleet session affinity: requests
+    #   sharing a session_id route to the same replica (their KV prefix
+    #   reuse stays local); None = no stickiness. Single-engine serving
+    #   ignores it — determinism never depends on placement.
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -280,6 +280,46 @@ class Scheduler:
             p = self.pool.alloc()
         return p
 
+    def alloc_pages(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages all-or-nothing (LRU prefix-cache eviction
+        under pressure, like :meth:`_alloc_page`): the fleet KV handoff's
+        destination-side allocation. On exhaustion every page already
+        taken is returned to the pool — a failed transfer must leave
+        ``free + live == num_pages`` intact on this side too."""
+        got: List[int] = []
+        for _ in range(int(n)):
+            p = self._alloc_page()
+            if p is None:
+                for q in got:
+                    self.pool.decref(q)
+                return None
+            got.append(p)
+        return got
+
+    def adopt(self, state: RequestState) -> int:
+        """Adopt an in-flight DECODE request whose KV this scheduler's
+        arena already holds (the fleet's prefill→decode handoff: the
+        caller imported the page payload and set ``state.pages`` to pages
+        allocated FROM THIS scheduler's pool via :meth:`alloc_pages`).
+        Returns the slot. The slot is marked fresh so its first decode
+        feed clears the previous occupant's stale ``seen`` row."""
+        if not self._free:
+            raise RuntimeError("adopt: no free slot")
+        if state.status is not RequestStatus.DECODE:
+            raise ValueError(
+                f"adopt needs a DECODE state, got {state.status.value}"
+            )
+        if self.paged and len(state.pages) > self.pages_per_slot:
+            raise ValueError(
+                f"adopt: {len(state.pages)} pages exceed pages_per_slot "
+                f"{self.pages_per_slot}"
+            )
+        slot = self._free.pop()
+        state.slot = slot
+        self.slots[slot] = state
+        self._fresh.add(slot)
+        return slot
+
     def _prepare_pages(self, state: RequestState, start: int,
                        n: int) -> tuple:
         """Make [start, start + n) writable for one slot: allocate fresh
@@ -458,6 +498,10 @@ class Scheduler:
             plan.start_pos[slot] = pos
             plan.sample[slot] = True
             plan.spec_len[slot] = n - 1
+            # an ADOPTED slot (fleet handoff) enters decode directly: its
+            # first feed clears the previous occupant's stale seen row
+            plan.fresh[slot] = slot in self._fresh
+            self._fresh.discard(slot)
             if self.paged:
                 plan.cow_src[slot] = cow
                 plan.page_table[slot, :len(state.pages)] = state.pages

@@ -1,0 +1,518 @@
+"""Fleet: disaggregated, replicated serving tier (ISSUE 13).
+
+The routing oracle: ANY routing of a staggered-arrival trace across N
+replicas — prefix-affinity routing, least-loaded spill, and at least one
+prefill→decode KV handoff — reproduces a single-replica serial replay
+token-for-token (greedy AND sampled-with-shared-keys, paged, spec-on),
+with ``step_traces == 1`` per replica. Plus the host-side units: the
+public ``PrefixCache.longest_chain`` lookup (collisions degrade to
+misses), the global prefix index mirrored from cache events, page
+export/import with the ``free + live == num_pages`` invariant on both
+pools (forced mid-transfer LRU eviction included), fleet-level load
+shedding / session affinity, config validation, and metrics
+aggregation.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import DeepSpeedConfigError, ServingConfig, _parse_dc
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.serving import (PagePool, PrefixCache, Request,
+                                   RequestStatus, Scheduler, ServingEngine,
+                                   ServingMetrics, chain_hashes,
+                                   export_pages, import_pages)
+from deepspeed_tpu.serving.fleet import GlobalPrefixIndex, Router
+from deepspeed_tpu.serving.metrics import FleetMetrics
+from deepspeed_tpu.serving.paging import chain_hash
+
+
+def tiny_llama(**kw):
+    d = dict(vocab_size=128, max_seq_len=64, hidden_size=32, num_layers=2,
+             num_heads=4, num_kv_heads=2, intermediate_size=64)
+    d.update(kw)
+    return llama("llama-tiny", **d)
+
+
+@pytest.fixture(scope="module")
+def inference_engine():
+    return deepspeed_tpu.init_inference(
+        tiny_llama(), dtype=jnp.float32, max_tokens=64,
+        rng=jax.random.PRNGKey(7),
+    )
+
+
+BASE_SERVING = {
+    "max_slots": 3, "token_budget": 8, "max_tokens": 64,
+    "paged": True, "page_size": 8,
+}
+
+
+def _serial_replay(engine, requests):
+    """The oracle's right-hand side: the same requests through ONE
+    ServingEngine, submitted up front (determinism makes arrival order
+    irrelevant — every request's RNG chain is its own)."""
+    srv = ServingEngine(engine=engine, serving=dict(BASE_SERVING))
+    states = [srv.submit(r) for r in requests]
+    srv.run_until_idle()
+    assert srv.step_traces == 1
+    return states
+
+
+# ---------------------------------------------------------------------------
+# the routing oracle
+# ---------------------------------------------------------------------------
+def test_fleet_oracle_2_replicas_greedy_and_sampled(inference_engine):
+    """2 mixed replicas, staggered arrivals, greedy AND
+    sampled-with-shared-keys in one trace == serial replay,
+    token-for-token; step_traces == 1 per replica."""
+    r = np.random.RandomState(0)
+    keys = [jax.random.PRNGKey(100 + i) for i in range(3)]
+    reqs = [
+        Request("g0", r.randint(0, 128, size=(5,)), max_new_tokens=6),
+        Request("g1", r.randint(0, 128, size=(11,)), max_new_tokens=4),
+        Request("s0", r.randint(0, 128, size=(7,)), max_new_tokens=8,
+                temperature=0.8, top_k=10, rng=keys[0]),
+        Request("s1", r.randint(0, 128, size=(4,)), max_new_tokens=5,
+                temperature=0.7, top_p=0.85, rng=keys[1]),
+        Request("s2", r.randint(0, 128, size=(9,)), max_new_tokens=6,
+                temperature=0.9, top_k=20, repetition_penalty=1.3,
+                rng=keys[2]),
+    ]
+
+    router = Router(engine=inference_engine, serving={
+        **BASE_SERVING, "fleet": {"enabled": True, "replicas": 2},
+    })
+    states = []
+    # staggered: two up front, the rest while the fleet is running
+    states.append(router.submit(reqs[0]))
+    states.append(router.submit(reqs[1]))
+    router.step()
+    states.append(router.submit(reqs[2]))
+    router.step()
+    states.append(router.submit(reqs[3]))
+    states.append(router.submit(reqs[4]))
+    router.run_until_idle()
+
+    want = _serial_replay(inference_engine, reqs)
+    for st, ws in zip(states, want):
+        assert st.status is RequestStatus.DONE
+        np.testing.assert_array_equal(st.output(), ws.output(),
+                                      err_msg=st.request.request_id)
+    # zero recompiles after warmup, PER replica
+    assert router.step_traces == [1, 1]
+    assert router.metrics.snapshot()["finished"] == len(reqs)
+
+
+def test_fleet_oracle_disaggregated_spec_handoff(inference_engine):
+    """3 replicas (1 dedicated prefill, 2 decode), spec-on: every
+    request's KV crosses a prefill→decode page handoff and the output
+    still equals the serial replay token-for-token (spec-on is bitwise
+    spec-off, so the serial leg runs spec too). The page-pool leak
+    invariant is asserted inside every transfer."""
+    serving = {
+        **BASE_SERVING,
+        "spec": {"enabled": True, "max_draft": 3},
+        "fleet": {"enabled": True, "replicas": 3, "prefill_replicas": 1},
+    }
+    router = Router(engine=inference_engine, serving=serving)
+    r = np.random.RandomState(3)
+    reqs = [
+        Request(f"h{i}", r.randint(0, 128, size=(n,)), max_new_tokens=new)
+        for i, (n, new) in enumerate([(6, 8), (13, 5), (4, 7), (9, 6)])
+    ]
+    states = []
+    for rq in reqs:
+        states.append(router.submit(rq))
+        router.step()
+    router.run_until_idle()
+
+    srv = ServingEngine(engine=inference_engine, serving={
+        k: v for k, v in serving.items() if k != "fleet"
+    })
+    want = [srv.submit(rq) for rq in reqs]
+    srv.run_until_idle()
+    for st, ws in zip(states, want):
+        assert st.status is RequestStatus.DONE
+        np.testing.assert_array_equal(st.output(), ws.output(),
+                                      err_msg=st.request.request_id)
+    m = router.metrics
+    assert m.handoffs >= 1, "no prefill→decode handoff ever ran"
+    assert m.handoff_pages >= 1
+    # every replica that stepped compiled exactly once
+    stepped = [t for t in router.step_traces if t > 0]
+    assert stepped and all(t == 1 for t in stepped), router.step_traces
+
+
+def test_fleet_handoff_deferral_under_page_pressure(inference_engine):
+    """Decode pools at the liveness floor: concurrent handoff candidates
+    cannot all move — the transfer DEFERS (nothing changes on either
+    side, invariants assert inside handoff), the request keeps decoding
+    on the prefill replica, and outputs still match the serial replay."""
+    serving = {
+        "max_slots": 3, "token_budget": 8, "max_tokens": 64,
+        "paged": True, "page_size": 8, "num_pages": 9,  # == pages_per_slot
+        "fleet": {"enabled": True, "replicas": 3, "prefill_replicas": 1},
+    }
+    router = Router(engine=inference_engine, serving=serving)
+    r = np.random.RandomState(5)
+    reqs = [
+        Request(f"p{i}", r.randint(0, 128, size=(n,)), max_new_tokens=new)
+        for i, (n, new) in enumerate([(5, 8), (7, 8), (4, 6), (6, 7)])
+    ]
+    states = [router.submit(rq) for rq in reqs]
+    router.run_until_idle()
+
+    srv = ServingEngine(engine=inference_engine, serving={
+        k: v for k, v in serving.items() if k != "fleet"
+    })
+    want = [srv.submit(rq) for rq in reqs]
+    srv.run_until_idle()
+    for st, ws in zip(states, want):
+        np.testing.assert_array_equal(st.output(), ws.output(),
+                                      err_msg=st.request.request_id)
+    # with one-slot-deep decode pools and 3 prefill slots racing, at
+    # least one transfer must have deferred — and none may leak
+    assert router.metrics.handoffs >= 1
+    for rep in router.replicas:
+        rep.engine.scheduler.assert_page_invariants()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs >= 2 devices")
+def test_fleet_oracle_disaggregated_tp2(inference_engine):
+    """tp=2 disaggregated fleet: the KV pools are tp-sharded, so the
+    page-payload import must land back on EXACTLY the sharding the step
+    compiled against — a drifted carry would recompile (step_traces > 1)
+    and a wrong transfer would break the token oracle."""
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    topology = MeshTopology(dims=ParallelDims(tp=2),
+                            devices=jax.devices()[:2])
+    eng = deepspeed_tpu.init_inference(
+        tiny_llama(), dtype=jnp.float32, max_tokens=64, topology=topology,
+        rng=jax.random.PRNGKey(11),
+    )
+    serving = {
+        **BASE_SERVING,
+        "fleet": {"enabled": True, "replicas": 2, "prefill_replicas": 1},
+    }
+    router = Router(engine=eng, serving=serving)
+    r = np.random.RandomState(9)
+    reqs = [Request(f"tp{i}", r.randint(0, 128, size=(n,)),
+                    max_new_tokens=new)
+            for i, (n, new) in enumerate([(7, 5), (4, 6)])]
+    states = []
+    for rq in reqs:
+        states.append(router.submit(rq))
+        router.step()
+    router.run_until_idle()
+    srv = ServingEngine(engine=eng, serving=dict(BASE_SERVING))
+    want = [srv.submit(rq) for rq in reqs]
+    srv.run_until_idle()
+    for st, ws in zip(states, want):
+        np.testing.assert_array_equal(st.output(), ws.output(),
+                                      err_msg=st.request.request_id)
+    assert router.metrics.handoffs >= 1
+    stepped = [t for t in router.step_traces if t > 0]
+    assert stepped and all(t == 1 for t in stepped), router.step_traces
+
+
+# ---------------------------------------------------------------------------
+# longest_chain + collisions (satellite 1)
+# ---------------------------------------------------------------------------
+def test_longest_chain_public_lookup():
+    pool = PagePool(8)
+    cache = PrefixCache(pool, page_size=4)
+    toks = np.arange(10, dtype=np.int32)  # 2 full pages + a 2-token tail
+    pages = [pool.alloc() for _ in range(3)]
+    cache.insert(toks, pages)
+    hashes = chain_hashes(toks, 4)
+    assert len(hashes) == 2
+    assert cache.longest_chain(hashes) == 2
+    assert cache.longest_chain(hashes[:1]) == 1
+    # a diverging prompt chains differently from block 0 on
+    other = chain_hashes(np.arange(100, 110, dtype=np.int32), 4)
+    assert cache.longest_chain(other) == 0
+    # a chain that matches block 0 but diverges in block 1
+    mixed = [hashes[0], other[1]]
+    assert cache.longest_chain(mixed) == 1
+    # match() agrees with the hash walk when there is no collision
+    pages_out, covered = cache.match(toks)
+    assert covered == 10 and pages_out == pages
+
+
+def test_longest_chain_collision_degrades_to_miss():
+    """A forged crc32 collision (same chain hash, different tokens) may
+    fool the hash-only lookups — longest_chain and the router's global
+    index — but the token-verified match() path must degrade it to a
+    miss, never to wrong KV."""
+    pool = PagePool(8)
+    cache = PrefixCache(pool, page_size=4)
+    stored = np.arange(4, dtype=np.int32)
+    page = pool.alloc()
+    cache.insert(stored, [page])
+    probe = np.arange(50, 54, dtype=np.int32)  # different tokens
+    h_probe = chain_hashes(probe, 4)
+    # forge the collision: rekey the stored entry under the probe's hash
+    (_, (stored_page, stored_block)), = [
+        (k, v) for k, v in cache._full.items()
+    ]
+    cache._full.clear()
+    cache._full[h_probe[0]] = (stored_page, stored_block)
+    # the hash walk overstates...
+    assert cache.longest_chain(h_probe) == 1
+    # ...and the global index mirror would too (hash-only by design)
+    idx = GlobalPrefixIndex(page_size=4)
+    idx._hashes[0] = {h_probe[0]}
+    assert idx.longest_chain(0, h_probe) == 1
+    # but the token-verified match treats it as a MISS
+    pages_out, covered = cache.match(probe)
+    assert covered == 0 and pages_out == []
+    # and the true owner still matches its own tokens
+    pages_out, covered = cache.match(stored)
+    assert covered == 0 or covered == 4  # rekeyed entry: stored tokens
+    #   now hash elsewhere, so either outcome is a miss or the (rekeyed)
+    #   hash walk stopping at 0 — never wrong pages for the probe
+
+
+def test_global_index_tracks_cache_events():
+    pool = PagePool(8)
+    cache = PrefixCache(pool, page_size=4)
+    idx = GlobalPrefixIndex(page_size=4)
+    idx.attach(1, cache)
+    toks = np.arange(8, dtype=np.int32)
+    pages = [pool.alloc(), pool.alloc()]
+    cache.insert(toks, pages)
+    hashes = chain_hashes(toks, 4)
+    assert idx.longest_chain(1, hashes) == 2
+    assert idx.best(toks, [1]) == (1, 2)
+    # evicting the first link breaks the chain from the start
+    while cache.evict_lru():
+        pass
+    assert idx.longest_chain(1, hashes) == 0
+    assert idx.entries(1) == 0
+    # page-size mismatch is rejected (keys would not be comparable)
+    with pytest.raises(ValueError):
+        GlobalPrefixIndex(page_size=8).attach(2, cache)
+
+
+# ---------------------------------------------------------------------------
+# export/import pages + the leak invariant (satellite 2)
+# ---------------------------------------------------------------------------
+def _toy_pool(num_pages, page_size=4, layers=2, kv=2, hd=3, seed=0):
+    r = np.random.RandomState(seed)
+    shape = (layers, num_pages + 1, page_size, kv, hd)
+    return {
+        "k": jnp.asarray(r.randn(*shape).astype(np.float32)),
+        "v": jnp.asarray(r.randn(*shape).astype(np.float32)),
+    }
+
+
+def test_export_import_pages_roundtrip():
+    src = _toy_pool(6, seed=1)
+    dst = _toy_pool(6, seed=2)
+    payload = export_pages(src, [4, 1])
+    assert payload["k"].shape == (2, 2, 4, 2, 3)
+    out = import_pages(dst, payload, [0, 5])
+    np.testing.assert_array_equal(np.asarray(out["k"][:, 0]),
+                                  np.asarray(src["k"][:, 4]))
+    np.testing.assert_array_equal(np.asarray(out["v"][:, 5]),
+                                  np.asarray(src["v"][:, 1]))
+    # untouched pages keep the destination's bytes
+    np.testing.assert_array_equal(np.asarray(out["k"][:, 2]),
+                                  np.asarray(dst["k"][:, 2]))
+    # shape / leaf mismatches are loud
+    with pytest.raises(ValueError):
+        import_pages(dst, payload, [0])
+    with pytest.raises(KeyError):
+        import_pages(dst, {"k": payload["k"]}, [0, 5])
+
+
+def test_alloc_pages_forced_eviction_and_leak_invariant():
+    """The destination half of a handoff under pressure: alloc_pages
+    forces LRU prefix-cache eviction mid-transfer, and on true
+    exhaustion rolls its partial allocation back — ``free + live ==
+    num_pages`` holds either way."""
+    sched = Scheduler(max_slots=2, token_budget=4, max_tokens=16,
+                      page_size=4, num_pages=6, pages_per_slot=5,
+                      prefix_cache=True)
+    # fill the pool: 4 pages held by the prefix cache, 2 free
+    held = [sched.pool.alloc() for _ in range(4)]
+    sched.prefix_cache.insert(np.arange(16, dtype=np.int32), held)
+    for p in held:
+        sched.pool.decref(p)  # cache refs remain
+    assert sched.pool.free_count == 2
+    # needs 5: takes the 2 free + forcibly evicts cache entries
+    got = sched.alloc_pages(5)
+    assert got is not None and len(got) == 5
+    sched.pool.check_leaks()
+    for p in got:
+        sched.pool.decref(p)
+    sched.pool.check_leaks()
+    # exhaustion: ask for more than the pool — partial alloc rolled back
+    assert sched.alloc_pages(7) is None
+    sched.pool.check_leaks()
+    assert sched.pool.free_count + len(sched.prefix_cache.held_pages) >= 6
+
+
+# ---------------------------------------------------------------------------
+# shedding + affinity + config validation
+# ---------------------------------------------------------------------------
+def test_fleet_shedding_and_retry_after(inference_engine):
+    """Fleet queue_limit lifts the bounded-queue semantics: past the
+    bound, submit() returns an EVICTED state with exponential
+    retry_after — no exception, no replica ever sees the request."""
+    clock_t = [0.0]
+    router = Router(engine=inference_engine, clock=lambda: clock_t[0],
+                    serving={
+                        "max_slots": 1, "token_budget": 8, "max_tokens": 64,
+                        "eviction_backoff_s": 2.0,
+                        "fleet": {"enabled": True, "replicas": 2,
+                                  "queue_limit": 2},
+                    })
+    prompt = np.arange(4, dtype=np.int32)
+    # 2 slots (1/replica) fill first; then 2 queued reaches the bound
+    states = [router.submit(Request(f"q{i}", prompt, max_new_tokens=4))
+              for i in range(4)]
+    assert all(s.status is not RequestStatus.EVICTED for s in states)
+    shed = router.submit(Request("q4", prompt, max_new_tokens=4))
+    assert shed.status is RequestStatus.EVICTED
+    assert "fleet queue full" in shed.evict_reason
+    assert shed.retry_after == pytest.approx(2.0)  # backoff * 2**0
+    # resubmission while still saturated doubles the backoff
+    clock_t[0] = 3.0
+    shed2 = router.resubmit(shed)
+    assert shed2.status is RequestStatus.EVICTED
+    assert shed2.retry_after == pytest.approx(3.0 + 4.0)  # backoff * 2**1
+    assert router.metrics.shed == 2
+    router.run_until_idle()
+    # once drained, the resubmission routes normally
+    clock_t[0] = 10.0
+    ok = router.resubmit(shed2)
+    assert ok.status is not RequestStatus.EVICTED
+    router.run_until_idle()
+    assert ok.status is RequestStatus.DONE
+
+
+def test_fleet_session_affinity(inference_engine):
+    router = Router(engine=inference_engine, serving={
+        **BASE_SERVING,
+        "fleet": {"enabled": True, "replicas": 3,
+                  "routing": "round_robin"},
+    })
+    prompt = np.arange(6, dtype=np.int32)
+    router.submit(Request("a0", prompt, max_new_tokens=2,
+                          session_id="alice"))
+    first = router._sessions["alice"]
+    # round-robin would move on; affinity pins the session
+    for i in range(1, 4):
+        router.submit(Request(f"a{i}", prompt, max_new_tokens=2,
+                              session_id="alice"))
+        assert router._sessions["alice"] == first
+    assert router.metrics.affinity_routed == 3
+    # a different session lands elsewhere (round-robin advanced)
+    router.submit(Request("b0", prompt, max_new_tokens=2,
+                          session_id="bob"))
+    assert router._sessions["bob"] != first
+    router.run_until_idle()
+
+
+def test_fleet_config_validation():
+    # prefill_replicas >= replicas: every prefill needs a decode target
+    with pytest.raises(DeepSpeedConfigError):
+        _parse_dc(ServingConfig, {
+            "enabled": True, "paged": True,
+            "fleet": {"enabled": True, "replicas": 2,
+                      "prefill_replicas": 2},
+        }).validate()
+    # disaggregation without the paged arena: no page transfer exists
+    with pytest.raises(DeepSpeedConfigError):
+        _parse_dc(ServingConfig, {
+            "enabled": True, "paged": False,
+            "fleet": {"enabled": True, "replicas": 3,
+                      "prefill_replicas": 1},
+        }).validate()
+    with pytest.raises(DeepSpeedConfigError):
+        _parse_dc(ServingConfig, {
+            "fleet": {"enabled": True, "replicas": 0},
+        }).validate()
+    with pytest.raises(DeepSpeedConfigError):
+        _parse_dc(ServingConfig, {
+            "fleet": {"enabled": True, "routing": "random"},
+        }).validate()
+    with pytest.raises(DeepSpeedConfigError):
+        _parse_dc(ServingConfig, {
+            "fleet": {"enabled": True, "queue_limit": -1},
+        }).validate()
+    with pytest.raises(DeepSpeedConfigError):
+        _parse_dc(ServingConfig, {
+            "fleet": {"enabled": True, "prefix_balance_slack": -2},
+        }).validate()
+    # a valid section (the examples/ds_config_serving_fleet.json shape)
+    cfg = _parse_dc(ServingConfig, {
+        "enabled": True, "paged": True,
+        "fleet": {"enabled": True, "replicas": 3, "prefill_replicas": 1,
+                  "routing": "prefix", "affinity": True,
+                  "queue_limit": 64},
+    })
+    cfg.validate()
+    assert cfg.fleet.replicas == 3
+
+
+def test_fleet_metrics_aggregation():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    a, b = ServingMetrics(clock=clock), ServingMetrics(clock=clock)
+    fm = FleetMetrics([a, b], clock=clock)
+    a.tokens_out, b.tokens_out = 30, 10
+    a.finished, b.finished = 3, 1
+    a.queue_depth, b.queue_depth = 2, 1
+    a.ttft_s.extend([0.1, 0.2])
+    b.ttft_s.append(0.9)
+    fm.on_route("prefix")
+    fm.on_route("affinity")
+    fm.on_handoff(True, pages=3)
+    fm.on_handoff(False)
+    fm.on_shed("fleet queue full")
+    t[0] = 2.0
+    s = fm.snapshot()
+    assert s["tokens_out"] == 40 and s["finished"] == 4
+    assert s["queue_depth"] == 3
+    assert s["tokens_per_s"] == pytest.approx(20.0)
+    assert s["ttft_p95_s"] == pytest.approx(0.9)  # merged samples
+    assert s["handoffs"] == 1 and s["handoff_failures"] == 1
+    assert s["handoff_pages"] == 3
+    assert s["prefix_routed"] == 1 and s["affinity_routed"] == 1
+    assert s["shed"] == 1
+    assert fm.queue_depth == 3  # hw duck-type
+    # the watchdog/shed window is COMPLETION-ordered and bounded, fed by
+    # the router — not a replica-order concatenation (a trailing-window
+    # read must never see only the last replica's history)
+    fm.on_finish_ttft(0.1)
+    fm.on_finish_ttft(0.9)
+    fm.on_finish_ttft(0.2)
+    assert fm.ttft_s == [0.1, 0.9, 0.2]
+    assert "fleet metrics" in fm.summary()
+    assert len(fm.per_replica()) == 2
+
+
+def test_replica_serving_config_strips_fleet(inference_engine):
+    """Replica engines must not recurse into fleet construction, and
+    decode replicas drop their (dead-weight) prefix cache."""
+    router = Router(engine=inference_engine, serving={
+        **BASE_SERVING, "prefix_cache": True,
+        "fleet": {"enabled": True, "replicas": 3, "prefill_replicas": 1},
+    })
+    for rep in router.replicas:
+        assert not rep.engine.serving.fleet.enabled
+    assert router.replicas[0].engine.scheduler.prefix_cache is not None
+    assert router.replicas[1].engine.scheduler.prefix_cache is None
+    assert router.replicas[2].engine.scheduler.prefix_cache is None
+    # the index mirrors intake replicas only
+    assert router.index is not None
+    assert dataclasses.asdict(router.serving.fleet)["replicas"] == 3

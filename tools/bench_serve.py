@@ -16,6 +16,21 @@ continuous-batching serving engine on a CPU mesh.
                                                      # repetitive-prompt
                                                      # trace (n-gram drafts
                                                      # land acceptances)
+    python tools/bench_serve.py --replicas 2 --paged # FLEET replay: the
+                                                     # same trace through a
+                                                     # single replica, then
+                                                     # through the router
+                                                     # over N replicas —
+                                                     # prints fleet tokens/s
+                                                     # + p95 TTFT next to
+                                                     # the single-replica
+                                                     # number
+    python tools/bench_serve.py --replicas 3 --prefill-replicas 1 --paged
+                                                     # disaggregated fleet:
+                                                     # dedicated prefill
+                                                     # replica handing KV
+                                                     # to decode replicas
+                                                     # as page transfers
 
 Arrivals land on a VIRTUAL clock (exponential inter-arrival gaps at
 ``--rate`` requests/s); each engine step advances the clock by its
@@ -90,6 +105,204 @@ def build_trace(args):
         prompt = np.concatenate([system, user])
         trace.append((float(arrivals[i]), f"req-{i}", prompt, new))
     return trace
+
+
+def _serving_section(args) -> dict:
+    return {
+        "max_slots": args.slots,
+        "token_budget": args.token_budget,
+        "queue_limit": max(args.requests, 1),
+        "request_timeout_s": 1e9,  # the replay never times out
+        "max_tokens": 64,
+        "paged": args.paged,
+        "page_size": args.page_size,
+        "num_pages": args.num_pages,
+        "prefix_cache": not args.no_prefix_cache,
+        "spec": {
+            "enabled": args.spec,
+            "max_draft": args.max_draft,
+            "ngram_n": args.ngram_n,
+        },
+    }
+
+
+def _replay_stats(finished, clock):
+    """(tokens, tokens_per_s, ttft_p95_s) over the REPLAY's finished
+    states only — warmup requests (compile time) are not in the list."""
+    from deepspeed_tpu.serving.metrics import percentile
+
+    tokens = sum(len(st.tokens) for st in finished)
+    ttfts = [st.first_token_t - st.arrival_t for st in finished
+             if st.first_token_t is not None]
+    dur = max(clock(), 1e-9)
+    return tokens, tokens / dur, percentile(ttfts, 95)
+
+
+def _fleet_replay(args, engine, hw_section) -> int:
+    """--replicas N: the same Poisson trace through ONE replica, then
+    through the fleet Router — an apples-to-apples comparison on the
+    virtual clock. Replicas are data-parallel (a real deployment steps
+    them concurrently), so a fleet tick advances the clock by router
+    overhead + the SLOWEST replica's step, not the sum. Both legs warm
+    up first (one throwaway request per engine) so compile time never
+    pollutes the TTFT comparison."""
+    import time as _time
+
+    import numpy as np
+
+    from deepspeed_tpu.profiling.comm_logger import CommsLogger
+    from deepspeed_tpu.serving import Request, ServingEngine, ServingMetrics
+    from deepspeed_tpu.serving.fleet import Router
+
+    trace = build_trace(args)
+    serving = _serving_section(args)
+
+    def make_warmup(i):
+        return Request(request_id=f"warmup-{i}",
+                       prompt=np.full(2, args.vocab - 1, np.int32),
+                       max_new_tokens=2, temperature=0.0)
+
+    def drive(srv, clock, advance):
+        pending = list(trace)
+        finished = []
+        t_wall0 = _time.perf_counter()
+        has_work = (lambda: srv.scheduler.has_work) \
+            if hasattr(srv, "scheduler") else (lambda: srv.has_work)
+        while pending or has_work():
+            while pending and pending[0][0] <= clock():
+                at, rid, prompt, new = pending.pop(0)
+                st = srv.submit(Request(
+                    request_id=rid, prompt=prompt, max_new_tokens=new,
+                    temperature=args.temperature,
+                ))
+                if st.finished:
+                    finished.append(st)  # shed — surfaces in the stats
+            if not has_work():
+                clock.advance(max(pending[0][0] - clock(), 1e-6))
+                continue
+            t0 = _time.perf_counter()
+            finished.extend(srv.step())
+            advance(srv, _time.perf_counter() - t0, clock)
+        return finished, _time.perf_counter() - t_wall0
+
+    # ---- leg 1: single-replica baseline -------------------------------
+    base_clock = VirtualClock()
+    base = ServingEngine(engine=engine, clock=base_clock,
+                         metrics=ServingMetrics(clock=base_clock),
+                         serving=serving)
+    base.submit(make_warmup(0))
+    base.run_until_idle()
+    base_fin, base_wall = drive(
+        base, base_clock, lambda s, dt, c: c.advance(dt)
+    )
+    base_tok, base_tps, base_p95 = _replay_stats(base_fin, base_clock)
+
+    # ---- leg 2: the fleet ----------------------------------------------
+    fleet_clock = VirtualClock()
+    logger = CommsLogger()
+    fleet_serving = dict(serving)
+    fleet_serving["fleet"] = {
+        "enabled": True,
+        "replicas": args.replicas,
+        "prefill_replicas": args.prefill_replicas,
+        "routing": args.routing,
+    }
+    router = Router(
+        engine=engine, clock=fleet_clock, comm_logger=logger,
+        steptrace=(
+            {"enabled": True, "export_path": args.trace}
+            if args.trace else None
+        ),
+        healthwatch=hw_section,
+        serving=fleet_serving,
+    )
+    if router.tracer is not None:
+        logger.registry = router.tracer
+    for i, rep in enumerate(router.replicas):
+        rep.engine.submit(make_warmup(i))
+    router.run_until_idle()
+
+    def fleet_advance(r, wall, clock):
+        durs = r.last_tick_durations.values()
+        clock.advance(r.last_tick_overhead_s + max(durs, default=1e-6))
+
+    fleet_fin, fleet_wall = drive(router, fleet_clock, fleet_advance)
+    fleet_tok, fleet_tps, fleet_p95 = _replay_stats(fleet_fin, fleet_clock)
+
+    # ---- the comparison ------------------------------------------------
+    print(router.metrics.summary())
+    kv_line = logger.kv_summary(duration_s=fleet_clock())
+    if kv_line:
+        print(kv_line)
+    logger.stop()
+    speedup = fleet_tps / base_tps if base_tps > 0 else float("inf")
+    overhead = (
+        (fleet_p95 - base_p95) / base_p95 * 100.0 if base_p95 > 0 else 0.0
+    )
+    print(
+        f"single-replica: {base_tok} tokens, {base_tps:.1f} tok/s, "
+        f"p95 TTFT {base_p95 * 1e3:.1f} ms "
+        f"({base_clock():.2f} virtual s, {base_wall:.2f}s wall)"
+    )
+    print(
+        f"fleet (N={args.replicas}, prefill={args.prefill_replicas}, "
+        f"{args.routing}): {fleet_tok} tokens, {fleet_tps:.1f} tok/s "
+        f"({speedup:.2f}x), p95 TTFT {fleet_p95 * 1e3:.1f} ms "
+        f"({overhead:+.1f}% vs single) "
+        f"({fleet_clock():.2f} virtual s, {fleet_wall:.2f}s wall)"
+    )
+    m = router.metrics.snapshot()
+    print(
+        f"fleet routing: handoffs={m['handoffs']} "
+        f"(+{m['handoff_failures']} deferred, {m['handoff_pages']} pages "
+        f"moved), prefix_routed={m['prefix_routed']}, "
+        f"affinity_routed={m['affinity_routed']}, shed={m['shed']}"
+    )
+    print(
+        f"recompiles: step traces per replica = {router.step_traces} "
+        f"(zero-after-warmup criterion: 1 each), lockstep engine "
+        f"compiles={engine.num_compiles}"
+    )
+    if args.trace:
+        out = router.trace_export(args.trace)
+        print(f"steptrace: wrote aggregated fleet trace {out} "
+              f"(validate/report with tools/trace_report.py)")
+    if router.healthwatch is not None:
+        hw = router.healthwatch
+        fired = sorted(hw.counters)
+        print(f"healthwatch (fleet-wide): fired rules: "
+              f"{', '.join(fired) if fired else 'none'}")
+        if args.postmortem and hw.dump_count == 0:
+            hw.dump_postmortem(path=args.postmortem, reason="explicit")
+    if args.check_health:
+        counters = (router.healthwatch.counters
+                    if router.healthwatch is not None else {})
+        missing = [r for r in args.check_health.split(",")
+                   if r and r not in counters]
+        if missing:
+            print(f"ERROR: expected health rule(s) never fired: "
+                  f"{', '.join(missing)}")
+            return 1
+    done = sum(1 for st in fleet_fin if not st.evict_reason)
+    if done != args.requests:
+        print(f"ERROR: {args.requests - done} requests unfinished")
+        return 1
+    # the oracle rides along for free: both legs are deterministic, so
+    # any drift between routings IS a bug — check token-for-token
+    by_id = {st.request.request_id: st for st in base_fin}
+    for st in fleet_fin:
+        want = by_id.get(st.request.request_id)
+        if want is not None and st.tokens != want.tokens:
+            print(f"ERROR: {st.request.request_id} diverged from the "
+                  f"single-replica replay ({st.tokens} != {want.tokens})")
+            return 1
+    if args.check_recompiles:
+        bad = [t for t in router.step_traces if t != 1]
+        if bad:
+            print(f"ERROR: per-replica step traces {router.step_traces} "
+                  "— a replica recompiled after warmup (or never ran)")
+            return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -171,6 +384,20 @@ def main(argv=None) -> int:
                     help="comma-separated health/* rule names that MUST "
                          "have fired during the replay (the seeded-"
                          "anomaly CI gate); exit 1 otherwise")
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="fleet replay: route the trace across N "
+                         "data-parallel replicas behind the prefix-aware "
+                         "Router and print fleet tokens/s + p95 TTFT next "
+                         "to a single-replica baseline of the same trace "
+                         "(serving/fleet/; docs/serving.md \"Fleet\")")
+    ap.add_argument("--prefill-replicas", type=int, default=0, metavar="K",
+                    help="of --replicas, dedicate K to prefill "
+                         "(DistServe-style disaggregation; finished "
+                         "prefills hand their KV to decode replicas as "
+                         "page transfers — needs --paged)")
+    ap.add_argument("--routing", default="prefix",
+                    choices=["prefix", "least_loaded", "round_robin"],
+                    help="fleet routing policy (--replicas > 1)")
     args = ap.parse_args(argv)
     if (args.hw_queue_depth is not None or args.hw_ttft_p95 is not None
             or args.postmortem or args.check_health):
@@ -218,6 +445,8 @@ def main(argv=None) -> int:
             "postmortem_path": args.postmortem,
             "install_signal_handler": False,  # replay tool, not a prod run
         }
+    if args.replicas > 1:
+        return _fleet_replay(args, engine, hw_section)
     srv = ServingEngine(
         engine=engine,
         clock=clock,
@@ -228,22 +457,7 @@ def main(argv=None) -> int:
             if args.trace else None
         ),
         healthwatch=hw_section,
-        serving={
-            "max_slots": args.slots,
-            "token_budget": args.token_budget,
-            "queue_limit": max(args.requests, 1),
-            "request_timeout_s": 1e9,  # the replay never times out
-            "max_tokens": 64,
-            "paged": args.paged,
-            "page_size": args.page_size,
-            "num_pages": args.num_pages,
-            "prefix_cache": not args.no_prefix_cache,
-            "spec": {
-                "enabled": args.spec,
-                "max_draft": args.max_draft,
-                "ngram_n": args.ngram_n,
-            },
-        },
+        serving=_serving_section(args),
     )
     if srv.tracer is not None:
         # the comms logger's stream records land on the same timeline

@@ -26,10 +26,12 @@ The ``--validate`` contract (the CI gate in ci.yml):
   (category, id, name) with no end-before-begin;
 - every request span tree is CLOSED: a ``serve.request`` id must open
   with QUEUED and terminate in a DONE or EVICTED instant;
-- per engine step (``serve/step`` / ``train/step``), the sum of its
-  phase spans' self-times must land within ``--coverage-tol`` (default
-  10%) of the step's measured wall clock — phases that silently stop
-  covering the step are how attribution rots.
+- per engine step (``serve/step`` / ``train/step``) and per fleet
+  router tick (``fleet/tick`` — the aggregated fleet trace from
+  ``Router.trace_export`` / ``bench_serve --replicas N --trace``), the
+  sum of its phase spans' self-times must land within
+  ``--coverage-tol`` (default 10%) of the step's measured wall clock —
+  phases that silently stop covering the step are how attribution rots.
 """
 
 from __future__ import annotations
@@ -40,7 +42,7 @@ import sys
 from collections import defaultdict
 from typing import Any, Dict, List
 
-STEP_NAMES = ("serve/step", "train/step")
+STEP_NAMES = ("serve/step", "train/step", "fleet/tick")
 REQUEST_CAT = "serve.request"
 TERMINALS = ("DONE", "EVICTED")
 # absolute slack on the per-step coverage check: host scheduling jitter
