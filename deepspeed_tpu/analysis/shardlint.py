@@ -334,9 +334,17 @@ def lint_serving_config(config, model=None, topology=None,
         else DeepSpeedConfig(config)
     )
     tp = max(int(ds.tensor_parallel.tp_size), 1)
+    # MoE serving configs lint on the ep mesh they would serve on (the
+    # expert exchange only exists in the traced program when the ep axis
+    # does) — serving_ep_size is the ONE moe.ep_size clamp, shared with
+    # trace_serving_step
+    from ..serving.engine import serving_ep_size
+
+    ep = serving_ep_size(ds.moe, getattr(model, "config", None))
     if topology is None:
         topology = MeshTopology(
-            dims=ParallelDims(tp=tp), devices=jax.devices()[:tp]
+            dims=ParallelDims(tp=tp, ep=ep),
+            devices=jax.devices()[:tp * ep],
         )
     report = Report()
     name = source or "serving"

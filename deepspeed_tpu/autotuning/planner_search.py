@@ -30,7 +30,10 @@ Candidate axes:
   grad_wire/param_wire streams priced statically (``wire_codecs``
   constructor arg; ("fp32",) collapses the axis);
 - serving ``token_budget`` for serving-enabled configs (the slot step
-  is traced through ``lint_serving_config`` instead of a train step);
+  is traced through ``lint_serving_config`` instead of a train step),
+  crossed with the serving-side moe-a2a form (stock vs chunked decode
+  exchange, ISSUE 14) when the config serves a MoE model expert-parallel
+  — static-only, the PR-7 serving-measurement refusal stands;
 - mesh shape (dp×tp factorizations) for capacity dryruns — CLI-only,
   ``tools/autoplan.py --dryrun-mesh``;
 - flash tiles are enumerable but *plan-invariant* (the traced program
@@ -121,6 +124,8 @@ class Candidate:
             parts.append(f"pw-{self.param_wire}")
         if self.token_budget is not None:
             parts = [f"serve-tb{self.token_budget}"]
+            if self.moe_a2a is not None:
+                parts.append("a2achunk" if self.moe_a2a else "a2astock")
         if self.mesh is not None:
             parts.append(f"dp{self.mesh[0]}xtp{self.mesh[1]}")
         if any(self.flash_blocks):
@@ -299,7 +304,31 @@ class PlannerSearch:
 
         ds = DeepSpeedConfig(dict(self.base_config))
         if getattr(ds.serving, "enabled", False):
-            return [Candidate(token_budget=tb) for tb in self.token_budgets]
+            # serving-side moe-a2a axis (ISSUE 14): stock vs chunked
+            # decode exchange, enumerated only when an expert exchange
+            # exists (MoE model + ep > 1). Static-only, like every
+            # serving candidate — the PR-7 refusal semantics hold:
+            # Autotuner._measure raises loudly on serving configs, so
+            # the axis is ranked by the planner and never compiled here.
+            # the same ep clamp the serving trace applies (ONE
+            # definition — serving_ep_size against the MODEL config,
+            # the source of truth): an ep that serves dense-replicated
+            # traces the identical program for both forms, and
+            # enumerating the axis there would rank duplicate plans
+            # (the PR-12 grad_wire-axis lesson)
+            from ..serving.engine import serving_ep_size
+
+            serve_moe = serving_ep_size(
+                ds.moe, getattr(self.model, "config", None)
+            ) > 1
+            serve_a2a: List[Optional[bool]] = (
+                [False, True] if serve_moe else [None]
+            )
+            return [
+                Candidate(token_budget=tb, moe_a2a=a2a)
+                for tb in self.token_budgets
+                for a2a in serve_a2a
+            ]
         mbs = []
         m = 1
         while m <= self.tuner.max_micro:
@@ -403,11 +432,19 @@ class PlannerSearch:
             tp["overlap_comm"] = oc
             cfg["tensor_parallel"] = tp
         if cand.moe_a2a is not None:
-            moe = dict(cfg.get("moe") or {})
-            oa = dict(moe.get("overlap_a2a") or {})
-            oa["enabled"] = bool(cand.moe_a2a)
-            moe["overlap_a2a"] = oa
-            cfg["moe"] = moe
+            if cand.token_budget is not None:
+                # serving candidates: the knob is the serving-side form
+                # (stock vs chunked decode exchange), not the training
+                # overlap_a2a scope
+                sv = dict(cfg.get("serving") or {})
+                sv["moe_a2a"] = "chunked" if cand.moe_a2a else "stock"
+                cfg["serving"] = sv
+            else:
+                moe = dict(cfg.get("moe") or {})
+                oa = dict(moe.get("overlap_a2a") or {})
+                oa["enabled"] = bool(cand.moe_a2a)
+                moe["overlap_a2a"] = oa
+                cfg["moe"] = moe
         if cand.z3_prefetch is not None:
             zo = dict(cfg.get("zero_optimization") or {})
             zo["stage3_layer_prefetch"] = bool(cand.z3_prefetch)

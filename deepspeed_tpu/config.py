@@ -495,6 +495,16 @@ class ServingConfig:
     prefix_cache: bool = True    # hash-of-prefix → shared read-only pages
                                  # with refcounts + copy-on-write (paged
                                  # mode only)
+    moe_a2a: str = "auto"        # decode-shaped expert-exchange form for
+                                 # MoE models served expert-parallel
+                                 # (ep > 1): "stock" = GSPMD collectives
+                                 # (the latency-bound small-step default),
+                                 # "chunked" = the a2a_overlap chunked-
+                                 # ppermute ring (hops hide under per-
+                                 # chunk expert FFNs), "auto" = stock
+                                 # below a per-hop payload threshold,
+                                 # chunked above it. Bitwise-equal forms;
+                                 # planner_search enumerates the axis.
     spec: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
                                  # speculative decoding (draft-then-verify
                                  # per decode slot); see SpecDecodeConfig
@@ -553,6 +563,11 @@ class ServingConfig:
             raise DeepSpeedConfigError(
                 f"serving.num_pages must be >= 0 (0 = auto), got "
                 f"{self.num_pages}"
+            )
+        if self.moe_a2a not in ("auto", "stock", "chunked"):
+            raise DeepSpeedConfigError(
+                "serving.moe_a2a must be auto|stock|chunked, got "
+                f"{self.moe_a2a!r}"
             )
         if self.spec.enabled:
             # a disabled spec section is inert (the engine maps it to

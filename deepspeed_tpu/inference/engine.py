@@ -80,6 +80,7 @@ def init_inference(
     model,
     tensor_parallel: Optional[Dict[str, Any]] = None,
     tp_size: int = 1,
+    ep_size: int = 1,
     dtype=jnp.bfloat16,
     replace_with_kernel_inject: bool = False,
     quantize_bits: Optional[int] = None,
@@ -101,6 +102,11 @@ def init_inference(
     — the "inference.matvec_max_rows" knob) widens the row threshold under
     which packed int8/int4 projections take the Pallas streaming matvec:
     e.g. the k=9 speculative verify window is 10 rows and needs ≥ 10.
+
+    ``ep_size`` > 1 serves a MoE model EXPERT-PARALLEL: the mesh grows an
+    ``ep`` axis (tp_size · ep_size devices), expert banks shard E over it
+    per the model's partition specs, and the decode MLP's expert exchange
+    runs over that axis (docs/serving.md "MoE serving").
     """
     if config:
         if matvec_max_rows is None and "matvec_max_rows" in config:
@@ -145,9 +151,11 @@ def init_inference(
         dtype = jnp.bfloat16
         quantize_bits = quantize_bits or 4
     if topology is None:
-        n = tp_size if tp_size > 1 else 1
+        ep_size = max(int(ep_size), 1)
+        n = max(tp_size, 1) * ep_size
         topology = MeshTopology(
-            dims=ParallelDims(tp=tp_size), devices=jax.devices()[:n]
+            dims=ParallelDims(tp=tp_size, ep=ep_size),
+            devices=jax.devices()[:n],
         )
     return InferenceEngine(
         model,
@@ -381,11 +389,27 @@ class InferenceEngine:
             if name not in big or leaf.ndim < 2:
                 return leaf
             if leaf.ndim > 3:
-                # MoE expert banks [L, E, d, f]: moe_layer's batched expert
-                # einsums consume dense weights, so experts take the
-                # fake-quant roundtrip (same numerics, bf16 stream) until
-                # the dispatch path learns PackedWeight
-                return quantize_dequantize(leaf, block=128, bits=bits)
+                # MoE expert banks [L, E, d, f] PACK since ISSUE 14: the
+                # decode dispatch path consumes PackedWeight natively
+                # (moe/sharded_moe._expert_proj → the per-expert Pallas
+                # streaming matvec, per-shard under ep/tp meshes) and the
+                # training/apply path dequantizes once (bitwise the old
+                # fake-quant roundtrip — same q/dq values)
+                if leaf.ndim != 4 or (
+                    sharded and not self._expert_bank_sharding_ok(
+                        leaf.shape, spec, bits
+                    )
+                ):
+                    log_dist(
+                        f"quantize: expert bank {name} falls back to "
+                        f"fake-quant (geometry {leaf.shape} does not pack "
+                        f"over mesh spec {spec})"
+                    )
+                    return quantize_dequantize(leaf, block=128, bits=bits)
+                pw = pack_quantize_blockwise(leaf, block=128, bits=bits)
+                if sharded:
+                    pw.pspec = spec
+                return pw
             if sharded and not packed_sharding_ok(
                 leaf.shape, spec, self.topology.mesh, block=128, bits=bits
             ):
@@ -403,6 +427,30 @@ class InferenceEngine:
         if sharded:
             return jax.tree_util.tree_map_with_path(q, params, tp_specs)
         return jax.tree_util.tree_map_with_path(q, params)
+
+    def _expert_bank_sharding_ok(self, shape, spec, bits: int) -> bool:
+        """Whether a stacked expert bank [L, E, d, f] packs under this
+        mesh spec: the trailing (d, f) dims obey the shared
+        packed_sharding_ok block/nibble rules, expert shards keep whole
+        experts (E divides the dim -3 extent), and the stacked layer dim
+        stays unsharded (a scanned per-layer slice must be a whole
+        bank)."""
+        from ..ops.quantizer import _axis_size
+
+        if spec is None:
+            return True
+        if not packed_sharding_ok(
+            shape, spec, self.topology.mesh, block=128, bits=bits
+        ):
+            return False
+        s = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+        if any(e is not None for e in s[:-3]):
+            return False
+        try:
+            e_extent = _axis_size(self.topology.mesh, s[-3])
+        except KeyError:
+            return False
+        return shape[-3] % max(e_extent, 1) == 0
 
     # ------------------------------------------------- planner metadata
     def analytic_streams(self, batch: int = 1, seq: Optional[int] = None,

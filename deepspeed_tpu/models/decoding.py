@@ -428,17 +428,31 @@ def _cached_attention(cfg: TransformerConfig, p: Params, x: jax.Array,
 def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Array,
                        cache: Cache, cache_len, *,
                        dtype=jnp.bfloat16,
-                       page_table=None) -> Tuple[jax.Array, Cache]:
+                       page_table=None,
+                       token_valid=None,
+                       return_moe_stats: bool = False):
     """Run new tokens through all layers against the cache.
 
     input_ids: [B, S] (prefill) or [B, 1] (decode). cache_len: tokens already
     cached — a shared scalar, or a per-row [B] vector for the serving
     engine's ragged slot batch. Returns (fp32 logits [B, S, V], updated
-    cache).
+    cache) — plus per-step MoE load-balance stats as a third element when
+    ``return_moe_stats`` is set on a routed-expert model.
 
     ``page_table`` [B, max_pages] switches ``cache`` to the block-paged
     pool form (init_paged_cache): every layer scatters its chunk through
     the shared table and attends a gathered per-slot view.
+
+    MoE models route the MLP through the serving expert path
+    (moe/sharded_moe.moe_serving_mlp): slot-ragged gather dispatch over
+    experts ep-sharded on the mesh, with capacity derived from the
+    STATIC token budget. ``token_valid`` [B, S] marks the real positions
+    of a slot-ragged chunk (the serving engine passes
+    ``pos < num_new``); padded tails, idle slots and done rows route to
+    the null expert — zero capacity, zero combine weight — so occupancy
+    changes never change routing pressure (or the compiled program).
+    ``token_valid=None`` (the lockstep engine) treats every position as
+    real and budgets capacity at B·S.
     """
     B, S = input_ids.shape
     from ..ops.quantizer import cast_floating
@@ -462,6 +476,8 @@ def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Ar
     layers = cast(params["layers"])
 
     quantized = "k_scale" in cache
+    moe = cfg.is_moe
+    collect_moe = bool(return_moe_stats) and moe
 
     def body(carry, scanned):
         h = carry
@@ -481,20 +497,52 @@ def forward_with_cache(cfg: TransformerConfig, params: Params, input_ids: jax.Ar
             new_cache = (kc, vc)
         h = h + a
         normed = _norm(cfg, layer["ln2"], h)
-        m, _aux = _mlp(cfg, layer["mlp"], normed, rng=None, train=False)
+        if moe:
+            from ..moe.sharded_moe import moe_serving_mlp
+
+            # the routed decode path: capacity from the STATIC budget
+            # (token_budget for the slot engine, B·S for lockstep),
+            # padded rows to the null expert
+            m, lstats = moe_serving_mlp(
+                cfg, layer["mlp"], normed, token_valid=token_valid,
+                budget_tokens=S if token_valid is not None else B * S,
+            )
+        else:
+            m, _aux = _mlp(cfg, layer["mlp"], normed, rng=None, train=False)
+            lstats = None
         h = h + m
         h = constrain(h, ("dp", "fsdp"), None, None)
-        return h, new_cache
+        ys = new_cache + (lstats,) if collect_moe else new_cache
+        return h, ys
 
     if quantized:
         scanned = (layers, cache["k"], cache["v"], cache["k_scale"],
                    cache["v_scale"])
-        x, (k_new, v_new, ks_new, vs_new) = lax.scan(body, x, scanned)
+        x, ys = lax.scan(body, x, scanned)
+        if collect_moe:
+            k_new, v_new, ks_new, vs_new, lstats = ys
+        else:
+            k_new, v_new, ks_new, vs_new = ys
         new_cache = {"k": k_new, "v": v_new, "k_scale": ks_new,
                      "v_scale": vs_new}
     else:
-        x, (k_new, v_new) = lax.scan(body, x, (layers, cache["k"], cache["v"]))
+        x, ys = lax.scan(body, x, (layers, cache["k"], cache["v"]))
+        if collect_moe:
+            k_new, v_new, lstats = ys
+        else:
+            k_new, v_new = ys
         new_cache = {"k": k_new, "v": v_new}
     x = _norm(cfg, cast(params["final_norm"]), x)
     logits = lm_head_logits(cfg, params, x)
+    if return_moe_stats:
+        moe_stats = None
+        if collect_moe:
+            # per-layer stacks → one per-step view (the metrics counters)
+            moe_stats = {
+                "tokens_per_expert": jnp.sum(
+                    lstats["tokens_per_expert"], axis=0
+                ),
+                "drop_fraction": jnp.mean(lstats["drop_fraction"]),
+            }
+        return logits, new_cache, moe_stats
     return logits, new_cache

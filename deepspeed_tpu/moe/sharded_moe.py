@@ -46,13 +46,34 @@ def _a2a_overlap_active(B: int, S: int, E: int, F: int):
     return cfg, topo
 
 
-def _gating_rounds(logits, top_k, capacity, rng, train, noise_std):
+def _gating_rounds(logits, top_k, capacity, rng, train, noise_std,
+                   valid=None):
     """The shared top-k selection loop: per-round (expert idx, slot pos,
     keep mask, raw gate value) plus the aux metrics. ONE implementation so
-    the einsum and gather dispatch paths cannot diverge."""
+    the einsum and gather dispatch paths cannot diverge.
+
+    The inference path accepts ``rng=None`` without consuming a key:
+    router noise is only ever sampled when TRAINING with
+    ``noise_std > 0`` — gating at eval is bitwise identical with and
+    without an rng, so serving's deterministic per-request RNG discipline
+    never threads a key through the router (unit-tested in
+    tests/test_moe.py).
+
+    ``valid`` ([N] bool, optional) is the serving engine's null-expert
+    contract: rows marked invalid (padded chunk tails, idle slots, done
+    requests) never enter the selection — they occupy no capacity slot,
+    shift no other token's cumsum position, and carry zero combine
+    weight — so routing of the REAL tokens is independent of batch
+    occupancy and the one fixed-shape step never recompiles (or drops
+    differently) as occupancy changes."""
     N, E = logits.shape
     if train and noise_std > 0.0 and rng is not None:
         logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+    if valid is not None:
+        # zeroed (not -inf) logits: invalid rows route through finite
+        # uniform gates, so no NaN/inf can leak out of garbage hidden
+        # states into the masked arithmetic below
+        logits = jnp.where(valid[:, None], logits, 0.0)
     gates = jax.nn.softmax(logits, axis=-1)  # [N, E]
 
     fill = jnp.zeros((E,), jnp.int32)
@@ -65,11 +86,15 @@ def _gating_rounds(logits, top_k, capacity, rng, train, noise_std):
     for _ in range(top_k):
         idx = jnp.argmax(masked_gates, axis=-1)  # [N]
         onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [N, E]
+        if valid is not None:
+            onehot = onehot * valid[:, None].astype(onehot.dtype)
         # position of each token within its chosen expert (this round)
         pos_in_round = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
         pos = pos_in_round + fill[None, :] * onehot
         pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
         keep = pos_tok < capacity
+        if valid is not None:
+            keep = keep & valid
         gate_val = jnp.sum(gates * onehot, axis=-1)  # [N]
         rounds.append((idx, pos_tok, keep, gate_val))
         fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
@@ -79,8 +104,23 @@ def _gating_rounds(logits, top_k, capacity, rng, train, noise_std):
 
     aux_loss = E * jnp.sum(me * (ce_acc / top_k))
     z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
-    dropped = 1.0 - kept_total / (N * top_k)
-    metrics = {"aux_loss": aux_loss, "z_loss": z_loss, "drop_fraction": dropped}
+    n_routed = (
+        jnp.sum(valid.astype(jnp.float32)) if valid is not None
+        else jnp.asarray(float(N))
+    )
+    dropped = jnp.where(
+        n_routed > 0, 1.0 - kept_total / jnp.maximum(n_routed * top_k, 1.0),
+        0.0,
+    )
+    metrics = {
+        "aux_loss": aux_loss,
+        "z_loss": z_loss,
+        "drop_fraction": dropped,
+        # serving load-balance observability: tokens that actually landed
+        # a capacity slot, per expert (the fill counters)
+        "tokens_per_expert": fill,
+        "routed_tokens": kept_total.astype(jnp.int32),
+    }
     return rounds, metrics
 
 
@@ -91,16 +131,18 @@ def top_k_gating(
     rng: Optional[jax.Array],
     train: bool,
     noise_std: float = 0.0,
+    valid: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Returns (dispatch [N,E,C] bool-ish, combine [N,E,C], aux metrics).
 
     Parity: TopKGate.forward (deepspeed/moe/sharded_moe.py top1gating/top2gating):
     softmax gates, top-k experts per token, positions via cumsum, overflow
     tokens dropped, load-balance loss = E * mean(gate_frac * token_frac).
+    ``valid`` is the serving null-expert mask (see :func:`_gating_rounds`).
     """
     N, E = logits.shape
     rounds, metrics = _gating_rounds(logits, top_k, capacity, rng, train,
-                                     noise_std)
+                                     noise_std, valid=valid)
     combine = jnp.zeros((N, E, capacity), jnp.float32)
     dispatch = jnp.zeros((N, E, capacity), jnp.bool_)
     for idx, pos_tok, keep, gate_val in rounds:
@@ -125,6 +167,7 @@ def top_k_gating_indices(
     rng: Optional[jax.Array],
     train: bool,
     noise_std: float = 0.0,
+    valid: Optional[jax.Array] = None,
 ):
     """Index-table form of :func:`top_k_gating` (same selection loop).
 
@@ -133,10 +176,12 @@ def top_k_gating_indices(
     The one-hot dispatch/combine einsums are permutations written as dense
     dots — O(N·E·C·D) MXU flops to move O(N·D) values; these tables drive
     plain gathers instead (O(N·D·K) bytes), the sort-based formulation TPU
-    MoE stacks use (and the reference's all-to-all ordering implies)."""
+    MoE stacks use (and the reference's all-to-all ordering implies).
+    ``valid`` is the serving null-expert mask (see :func:`_gating_rounds`):
+    invalid rows never occupy a slot and carry zero combine weight."""
     N, E = logits.shape
     rounds, metrics = _gating_rounds(logits, top_k, capacity, rng, train,
-                                     noise_std)
+                                     noise_std, valid=valid)
     # one extra dummy slot soaks up dropped tokens' scatter writes
     tok_flat = jnp.zeros((E * capacity + 1,), jnp.int32)
     valid_flat = jnp.zeros((E * capacity + 1,), jnp.bool_)
@@ -164,6 +209,96 @@ def top_k_gating_indices(
     )
 
 
+def eval_capacity(cfg, n_tokens: int) -> int:
+    """Per-expert capacity at inference for a program that feeds at most
+    ``n_tokens`` real tokens: ``max(4, ceil(max(capacity_factor, 2.0) ·
+    top_k · n_tokens / E))`` — the reference TopKGate eval rule. STATIC
+    given static shapes, which is what keeps the serving step at one
+    compile: the slot engine passes its token budget W (the scheduler
+    never packs more than W real tokens per step), so occupancy changes
+    never change capacity. No-drop guarantee: with
+    ``max(capacity_factor, 2.0) · top_k >= E`` even the adversarial
+    all-tokens-to-one-expert step fits, and per-token routing becomes
+    independent of batch composition (the spec-on == spec-off and
+    serving == generate parities for MoE need exactly that)."""
+    cap_factor = max(cfg.moe_capacity_factor, 2.0)
+    return max(4, int(math.ceil(cap_factor * cfg.moe_top_k * n_tokens
+                                / cfg.num_experts)))
+
+
+def _expert_proj(x: jax.Array, w) -> jax.Array:
+    """Batched per-expert projection x[E, C, d] @ w[E, d, n] → [E, C, n].
+
+    Dense expert banks take the plain einsum. PackedWeight banks
+    (weight-only int8/int4 expert weights, [L, E, d, n] packed by the
+    inference engine) stream through the Pallas matvec per expert
+    (ops/pallas/quantized_matmul.packed_expert_proj — per-shard under a
+    full-manual shard_map when the bank is ep/tp-sharded, the PR-3 tp
+    path applied to experts) when the row count fits the streaming
+    threshold; larger shapes dequantize once and ride the MXU."""
+    from ..ops.quantizer import PackedWeight
+
+    if isinstance(w, PackedWeight):
+        from ..ops.pallas.quantized_matmul import packed_expert_proj
+
+        y = packed_expert_proj(x, w)
+        if y is not None:
+            return y
+        return jnp.einsum("ecd,edf->ecf", x, w.dequantize())
+    return jnp.einsum("ecd,edf->ecf", x, w)
+
+
+def _expert_ffn(cfg, p: Dict, expert_in: jax.Array) -> jax.Array:
+    """The expert FFN stack on [E, C, D] capacity rows — ONE
+    implementation shared by the training layer, the serving routed path
+    and (structurally mirrored) the decode a2a ring, so the paths cannot
+    diverge. Handles PackedWeight expert banks via :func:`_expert_proj`."""
+    h = _expert_proj(expert_in, p["wi"])
+    if cfg.activation == "swiglu":
+        g = _expert_proj(expert_in, p["wg"])
+        h = jax.nn.silu(g) * h
+    else:
+        h = jax.nn.gelu(h)
+    h = constrain(h, "ep", None, "tp")
+    expert_out = _expert_proj(h, p["wo"])
+    return constrain(expert_out, "ep", None, None)
+
+
+def _residual_mix(cfg, p: Dict, x: jax.Array, out: jax.Array) -> jax.Array:
+    """Residual/PR-MoE (reference: deepspeed/moe/layer.py use_residual):
+    a dense MLP runs on every token and a learned per-token 2-way
+    softmax coefficient mixes dense vs routed outputs — the routed
+    branch acts as a correction on top of the always-on dense expert.
+    ONE implementation shared by the training layer and the serving
+    path, so the mixes cannot diverge."""
+    h = jnp.einsum("bsd,df->bsf", x, p["res_wi"])
+    if cfg.activation == "swiglu":
+        h = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, p["res_wg"])) * h
+    else:
+        h = jax.nn.gelu(h)
+    h = constrain(h, ("dp", "fsdp"), "sp", "tp")
+    dense = jnp.einsum("bsf,fd->bsd", h, p["res_wo"])
+    coef = jax.nn.softmax(
+        jnp.einsum(
+            "bsd,dc->bsc", x.astype(jnp.float32),
+            p["coef"].astype(jnp.float32),
+        ),
+        axis=-1,
+    ).astype(x.dtype)
+    return dense * coef[..., 0:1] + out * coef[..., 1:2]
+
+
+def _experts_packed(p: Dict) -> bool:
+    """Whether this layer's expert bank is weight-only quantized packed
+    storage (the a2a rings fall back to stock collectives for packed
+    leaves, exactly like the PR-3 tp rings do)."""
+    from ..ops.quantizer import PackedWeight
+
+    return any(
+        isinstance(p.get(k), PackedWeight) for k in ("wi", "wg", "wo")
+    )
+
+
 def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool):
     """Routed expert MLP. x: [B, S, D] → ([B, S, D], aux_loss scalar).
 
@@ -173,8 +308,11 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
     B, S, D = x.shape
     E = cfg.num_experts
     N = B * S
-    cap_factor = cfg.moe_capacity_factor if train else max(cfg.moe_capacity_factor, 2.0)
-    capacity = max(4, int(math.ceil(cap_factor * cfg.moe_top_k * N / E)))
+    if train:
+        capacity = max(4, int(math.ceil(cfg.moe_capacity_factor
+                                        * cfg.moe_top_k * N / E)))
+    else:
+        capacity = eval_capacity(cfg, N)
 
     tokens = x.reshape(N, D)
     router_logits = jnp.einsum(
@@ -192,6 +330,11 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
     # ppermute rings whose hops hide under the per-chunk expert FFN
     # (parallel/a2a_overlap.py); the serial GSPMD path below otherwise
     ov, otopo = _a2a_overlap_active(B, S, E, p["wi"].shape[-1])
+    if _experts_packed(p):
+        # packed int8/int4 expert banks stream through the Pallas matvec
+        # path; the decomposed ring moves dense chunks — fall back to the
+        # stock exchange (the PR-3 tp-ring rule applied to experts)
+        ov, otopo = None, None
     if use_gather:
         # permutation as gathers, not one-hot dots: O(N·D·K) moved bytes
         # instead of O(N·E·C·D) MXU flops each way
@@ -235,16 +378,7 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
                 "nec,nd->ecd", dispatch.astype(x.dtype), tokens
             )
         expert_in = constrain(expert_in, "ep", None, None)
-
-        h = jnp.einsum("ecd,edf->ecf", expert_in, p["wi"])
-        if cfg.activation == "swiglu":
-            g = jnp.einsum("ecd,edf->ecf", expert_in, p["wg"])
-            h = jax.nn.silu(g) * h
-        else:
-            h = jax.nn.gelu(h)
-        h = constrain(h, "ep", None, "tp")
-        expert_out = jnp.einsum("ecf,efd->ecd", h, p["wo"])
-        expert_out = constrain(expert_out, "ep", None, None)
+        expert_out = _expert_ffn(cfg, p, expert_in)
 
         if use_gather:
             picked = jnp.take(
@@ -258,22 +392,113 @@ def moe_layer(cfg, p: Dict, x: jax.Array, rng: Optional[jax.Array], train: bool)
     out = out.reshape(B, S, D)
 
     if cfg.moe_use_residual:
-        # Residual/PR-MoE (reference: deepspeed/moe/layer.py use_residual):
-        # a dense MLP runs on every token and a learned per-token 2-way
-        # softmax coefficient mixes dense vs routed outputs — the routed
-        # branch acts as a correction on top of the always-on dense expert.
-        h = jnp.einsum("bsd,df->bsf", x, p["res_wi"])
-        if cfg.activation == "swiglu":
-            h = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, p["res_wg"])) * h
-        else:
-            h = jax.nn.gelu(h)
-        h = constrain(h, ("dp", "fsdp"), "sp", "tp")
-        dense = jnp.einsum("bsf,fd->bsd", h, p["res_wo"])
-        coef = jax.nn.softmax(
-            jnp.einsum(
-                "bsd,dc->bsc", x.astype(jnp.float32), p["coef"].astype(jnp.float32)
-            ),
-            axis=-1,
-        ).astype(x.dtype)
-        out = dense * coef[..., 0:1] + out * coef[..., 1:2]
+        out = _residual_mix(cfg, p, x, out)
     return out, aux
+
+
+def moe_serving_mlp(cfg, p: Dict, x: jax.Array,
+                    token_valid: Optional[jax.Array] = None,
+                    budget_tokens: Optional[int] = None):
+    """Routed expert MLP for the decode/serving path (ISSUE 14):
+    x [B, S, D] → (out [B, S, D], load-balance stats).
+
+    The serving engine's contract, end to end:
+
+    - **capacity from the static token budget** — ``budget_tokens`` is
+      the most REAL tokens the caller can feed (the slot engine's
+      token_budget W; ``B·S`` for the lockstep engine where every
+      position is real), so :func:`eval_capacity` is static and the ONE
+      ``[max_slots, token_budget]`` step never recompiles as occupancy
+      changes;
+    - **null-expert padding** — ``token_valid`` [B, S] marks the real
+      positions; padded chunk tails, idle slots and done rows route to
+      no expert at all (zero capacity, zero combine weight, zero cumsum
+      shift — :func:`_gating_rounds`);
+    - **slot-ragged gather dispatch** — :func:`top_k_gating_indices`
+      index tables drive plain gathers (O(N·D·K) bytes), not the one-hot
+      dots (O(N·E·C·D) flops of data movement — decode steps are
+      latency-bound);
+    - **ep-sharded experts** — the FFN runs on [E, C, D] rows
+      constrained onto the ``ep`` mesh axis (stock collectives), or
+      through the decode-shaped chunked-ppermute ring
+      (parallel/a2a_overlap.moe_decode_a2a) when the ``a2a_scope`` is
+      active and shapes divide — both produce the FULL expert-output
+      tensor, so the combine below is ONE shared implementation and
+      ep-sharded output is bitwise the dense-replicated output;
+    - **packed int8/int4 expert weights** stream through the Pallas
+      matvec (:func:`_expert_proj`); packed banks always take the stock
+      exchange (the tp-ring fallback rule).
+
+    Returns ``(out, stats)`` with stats = {"tokens_per_expert" [E] i32,
+    "routed_tokens" i32, "drop_fraction" f32} — the serving metrics
+    counters (serving/metrics.py ``on_moe``)."""
+    B, S, D = x.shape
+    E = cfg.num_experts
+    N = B * S
+    K = cfg.moe_top_k
+    if budget_tokens is None:
+        budget_tokens = S if token_valid is not None else N
+    capacity = eval_capacity(cfg, int(budget_tokens))
+
+    tokens = x.reshape(N, D)
+    valid = token_valid.reshape(N) if token_valid is not None else None
+    router_logits = jnp.einsum(
+        "nd,de->ne", tokens.astype(jnp.float32),
+        p["router"].astype(jnp.float32),
+    )
+    tok_of_slot, slot_valid, slot_of_tok, w_of_tok, metrics = (
+        top_k_gating_indices(router_logits, K, capacity, rng=None,
+                             train=False, valid=valid)
+    )
+
+    ring_cfg = None
+    topo = current_topology()
+    if topo is not None and not _experts_packed(p):
+        from ..parallel.a2a_overlap import (current_a2a,
+                                            moe_decode_a2a_applicable)
+
+        ov = current_a2a()
+        if ov is not None and moe_decode_a2a_applicable(
+            topo, E=E, F=p["wi"].shape[-1], n_tokens=N
+        ):
+            ring_cfg = ov
+    if ring_cfg is not None:
+        # the chunked-ppermute decode ring runs dispatch + FFN + combine
+        # per ep member (each member emits its own token block, the
+        # stock combine expression verbatim — bitwise the stock path)
+        from ..parallel.a2a_overlap import moe_decode_a2a
+
+        out = moe_decode_a2a(
+            tokens, tok_of_slot, slot_valid, slot_of_tok, w_of_tok,
+            (p["wi"], p.get("wg") if cfg.activation == "swiglu" else None,
+             p["wo"]),
+            topo, chunks=int(ring_cfg.chunks),
+            bidirectional=bool(ring_cfg.bidirectional),
+        )
+    else:
+        expert_in = (
+            jnp.take(tokens, tok_of_slot.reshape(-1), axis=0)
+            .reshape(E, capacity, D)
+            * slot_valid[..., None].astype(x.dtype)
+        )
+        expert_in = constrain(expert_in, "ep", None, None)
+        expert_out = _expert_ffn(cfg, p, expert_in)
+        # combine: dropped/invalid tokens carry w == 0, so their slot-0
+        # fallback gather contributes exact zeros
+        picked = jnp.take(
+            expert_out.reshape(E * capacity, D), slot_of_tok.reshape(-1),
+            axis=0,
+        ).reshape(N, K, D)
+        out = jnp.sum(picked * w_of_tok[..., None].astype(x.dtype), axis=1)
+    out = out.reshape(B, S, D)
+
+    if cfg.moe_use_residual:
+        out = _residual_mix(cfg, p, x, out)
+
+    # routed_tokens stays derivable (tokens_per_expert.sum()) — the
+    # metrics layer re-derives it, so the step ships no redundant scalar
+    stats = {
+        "tokens_per_expert": metrics["tokens_per_expert"],
+        "drop_fraction": metrics["drop_fraction"],
+    }
+    return out, stats

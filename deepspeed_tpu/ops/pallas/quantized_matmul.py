@@ -157,8 +157,10 @@ def matvec_max_rows() -> int:
 
 # trace-time path observability: tests assert the tp>1 decode matvec
 # actually STREAMS (takes a kernel path) instead of only checking packed
-# HBM residency — counts bump when a path is traced, not per step
-_STREAM_TRACES = {"single": 0, "sharded": 0}
+# HBM residency — counts bump when a path is traced, not per step.
+# expert_* are the MoE expert-bank twins (packed_expert_proj).
+_STREAM_TRACES = {"single": 0, "sharded": 0, "expert_single": 0,
+                  "expert_sharded": 0}
 
 
 def streaming_trace_counts() -> dict:
@@ -166,8 +168,8 @@ def streaming_trace_counts() -> dict:
 
 
 def reset_streaming_trace_counts() -> None:
-    _STREAM_TRACES["single"] = 0
-    _STREAM_TRACES["sharded"] = 0
+    for k in _STREAM_TRACES:
+        _STREAM_TRACES[k] = 0
 
 
 def _spec_axes(entry) -> tuple:
@@ -275,6 +277,160 @@ def _packed_matvec_sharded(x2d, w, topo):
     )
     _STREAM_TRACES["sharded"] += 1
     return run(x2d, w.qdata, w.scale)
+
+
+def _expert_pspec_entries(w) -> tuple:
+    """(expert, row, col) PartitionSpec entries of a packed EXPERT BANK's
+    live [E, d, n] dims, or None. Mirrors :func:`_matvec_pspec_entries`:
+    the pspec is the ORIGINAL stacked [L, E, d, n] weight's spec — a
+    lax.scan over the stacked leaf hands the per-layer [E, d, n] slice
+    with the full spec still in aux, so only the trailing THREE entries
+    describe the live dims, and any sharded leading (layer) entry
+    disqualifies the wrapper."""
+    if w.pspec is None:
+        return None
+    ndim = max(len(w.shape), 3)
+    entries = tuple(w.pspec) + (None,) * (ndim - len(tuple(w.pspec)))
+    if any(e is not None for e in entries[:-3]):
+        return None
+    return entries[-3], entries[-2], entries[-1]
+
+
+def _expert_matvec_ok(w, topo, x_cols: int) -> bool:
+    """Whether the per-shard expert streaming kernel applies on this
+    mesh: a remembered spec whose expert shards keep whole experts,
+    whose column shards keep whole 128-lane tiles, and whose row shards
+    keep whole quantization blocks (int4 nibble pairs cannot split
+    across row shards)."""
+    rc = _expert_pspec_entries(w)
+    if rc is None or w.qdata.ndim != 4:
+        return False
+    e_axes, row_axes, col_axes = (_spec_axes(e) for e in rc)
+    mesh = topo.mesh
+    try:
+        ee = _axes_extent(mesh, e_axes)
+        re_ = _axes_extent(mesh, row_axes)
+        ce = _axes_extent(mesh, col_axes)
+    except KeyError:
+        return False
+    if ee == 1 and re_ == 1 and ce == 1:
+        return False  # replicated: the single-device expert path applies
+    E, G, N = w.qdata.shape[0], w.scale.shape[-3], w.scale.shape[-1]
+    return (
+        E % ee == 0
+        and N % ce == 0
+        and (N // ce) % 128 == 0
+        and G % re_ == 0
+        and x_cols % re_ == 0
+        and w.qdata.shape[1] % re_ == 0
+        and not (w.nibbles and re_ > 1)
+    )
+
+
+def _packed_expert_matvec_local(x3d, qdata, scale, *, nibbles: bool,
+                                block_n: int):
+    """Per-expert streaming matvecs on LOCAL [E, C, D] rows against the
+    local packed bank [E, G, B, n]: one kernel launch per expert (E is a
+    small static count — the per-weight-launch rule the r5 fusion A/B
+    settled stays)."""
+    return jnp.stack([
+        _packed_matvec(x3d[e], qdata[e], scale[e], block_n=block_n,
+                       nibbles=nibbles)
+        for e in range(x3d.shape[0])
+    ])
+
+
+def _packed_expert_sharded(x3d, w, topo):
+    """Run the expert streaming matvec PER SHARD under an ep (and/or tp)
+    mesh — the PR-3 full-manual shard_map treatment applied to expert
+    banks: a bare pallas_call has no GSPMD partitioning rule, so without
+    this wrapper ep-sharded qdata/scale operands dequantize full-width
+    in XLA every decode step. Expert shards are embarrassingly parallel;
+    column (tp) shards emit their output slice with no collective; row
+    (contraction) shards psum fp32 partials exactly like
+    :func:`_packed_matvec_sharded`."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...utils.jax_compat import shard_map
+
+    e_entry, row_e, col_e = _expert_pspec_entries(w)
+    row_axes = _spec_axes(row_e)
+    mesh = topo.mesh
+    re_ = _axes_extent(mesh, row_axes)
+    ce = _axes_extent(mesh, _spec_axes(col_e))
+    N_loc = w.scale.shape[-1] // ce
+    D_loc = x3d.shape[-1] // re_
+
+    def body(xl, qd, sc):
+        y = _packed_expert_matvec_local(
+            xl, qd, sc, nibbles=w.nibbles,
+            block_n=_pick_block_n(N_loc, D_loc),
+        )
+        if row_axes:
+            # contraction-sharded: fp32 reduce (the CPU AllReducePromotion
+            # workaround, same as _packed_matvec_sharded)
+            y = jax.lax.psum(y.astype(jnp.float32), row_axes).astype(y.dtype)
+        return y
+
+    run = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(e_entry, None, row_e),
+            P(e_entry, row_e, None, col_e),
+            P(e_entry, row_e, None, col_e),
+        ),
+        out_specs=P(e_entry, None, col_e),
+        axis_names=set(mesh.axis_names),
+        check_vma=False,
+    )
+    _STREAM_TRACES["expert_sharded"] += 1
+    return run(x3d, w.qdata, w.scale)
+
+
+def packed_expert_proj(x: jax.Array, w) -> "jax.Array | None":
+    """x [E, C, D] @ w [E, D, N] where w is a PackedWeight EXPERT BANK
+    (qdata [E, G, B, N]): the weight-only int8/int4 streaming matvec run
+    per expert, per shard — the serving MoE path's expert FFN
+    (moe/sharded_moe._expert_proj). Returns None when the streaming
+    kernel does not apply (row count over the matvec threshold, lanes
+    not tile-aligned, or an undividable shard geometry) and the caller
+    dequantizes into a regular MXU matmul instead."""
+    from ...models.sharding import current_topology
+
+    if w.qdata.ndim != 4 or w.scale.shape[-1] % 128 != 0:
+        return None
+    E, C, D = x.shape
+    if C > matvec_max_rows():
+        return None
+    N = w.scale.shape[-1]
+    topo = current_topology()
+    if topo is None or topo.world_size == 1:
+        _STREAM_TRACES["expert_single"] += 1
+        return _packed_expert_matvec_local(
+            x, w.qdata, w.scale, nibbles=w.nibbles,
+            block_n=_pick_block_n(N, D),
+        )
+    if _expert_matvec_ok(w, topo, D):
+        return _packed_expert_sharded(x, w, topo)
+    rc = _expert_pspec_entries(w)
+    if rc is not None:
+        try:
+            replicated = all(
+                _axes_extent(topo.mesh, _spec_axes(e)) == 1 for e in rc
+            )
+        except KeyError:
+            # pspec names an axis absent from this mesh: fall back to
+            # the dequantize path like every sibling predicate
+            replicated = False
+        if replicated:
+            # replicated on a >1 mesh: the single-device loop streams
+            _STREAM_TRACES["expert_single"] += 1
+            return _packed_expert_matvec_local(
+                x, w.qdata, w.scale, nibbles=w.nibbles,
+                block_n=_pick_block_n(N, D),
+            )
+    return None
 
 
 def packed_proj(x: jax.Array, w) -> jax.Array:

@@ -76,6 +76,9 @@ __all__ = [
     "moe_a2a_ffn",
     "moe_a2a_applicable",
     "moe_a2a_bytes_per_step",
+    "moe_decode_a2a",
+    "moe_decode_a2a_applicable",
+    "moe_decode_a2a_bytes_per_step",
 ]
 
 
@@ -446,6 +449,196 @@ def moe_a2a_ffn(x, gating, weights, topo=None, *, axis: str = "ep",
 
     args = (x,) + tuple(g) + (wi,) + ((wg,) if wg is not None else ()) + (wo,)
     return _shard_map_full(body, topo, in_specs, tok_spec)(*args)
+
+
+# -------------------------------------------------- decode-shaped exchange
+def moe_decode_a2a(tokens, tok_of_slot, slot_valid, slot_of_tok, w_of_tok,
+                   weights, topo=None, *, axis: str = "ep",
+                   chunks: int = 1, bidirectional: bool = False):
+    """Decode-shaped expert exchange for the serving engine (ISSUE 14):
+    tokens [N, D] REPLICATED, experts ep-sharded — returns the combined
+    per-token outputs [N, D].
+
+    The serving slot step is the opposite regime from training
+    (:func:`moe_a2a_ffn`): per-step token counts are tiny (at most the
+    token budget) and the slot batch is replicated, so the *dispatch*
+    half of the exchange is free — each ep member slices its experts'
+    rows straight out of its replicated token copy through the
+    ``top_k_gating_indices`` tables. What remains on the wire is the
+    *combine ride*: every member needs every expert block's outputs to
+    fold its tokens' top-k picks. This decomposes that all-gather into
+    chunked ``ppermute`` hops on the ep ring — chunk c's blocks ride
+    while chunk c+1's expert FFN runs (The Big Send-off's small-message
+    treatment: at decode sizes the exchange is latency- not
+    bandwidth-bound, which is why the serving engine's ``auto`` form
+    picks stock collectives below a payload threshold and this ring
+    above it).
+
+    Every member assembles the full [E, C, D] expert tensor from the
+    riding blocks (blocks land by expert index, not arrival order) and
+    then combines ITS OWN N/ep token block with the exact gather +
+    weighted-sum the stock path uses — so the output honestly claims
+    ep-PARTITIONED (shardlint R1's replication contract: a claim of
+    replication over blocks assembled from ppermute hops is beyond the
+    taint analysis, and partitioning is what each member actually owns)
+    and is bitwise the stock form AND the dense-replicated (ep = 1)
+    program — the tests/test_serving_moe.py oracle. GSPMD re-replicates
+    the tiny [N, D] result at the boundary.
+
+    Full-manual shard_map over the whole mesh (legacy jax 0.4.x safe);
+    every hop goes through ``comm.collectives.permute`` so the shardlint
+    R3 ring contract is enforced at construction (the seeded corpus pair
+    ``moe_decode_ring_malformed``/``_clean`` pins the hazard form).
+    """
+    topo = topo or current_topology()
+    ep = topo.sizes[axis]
+    if ep <= 1:
+        raise ValueError(f"moe_decode_a2a needs a >1 '{axis}' mesh axis")
+    wi, wg, wo = weights
+    E, C = tok_of_slot.shape
+    E_loc = E // ep
+    N, D = tokens.shape
+    if N % ep != 0:
+        raise ValueError(
+            f"moe_decode_a2a needs the token count {N} to divide ep={ep} "
+            "(each member combines its own token block)"
+        )
+    N_loc = N // ep
+    K = slot_of_tok.shape[1]
+    tp_live = topo.tp_size > 1
+    chunk_list = _row_chunks(C, chunks)
+    w_specs = (P(axis, None, "tp" if tp_live else None),
+               P(axis, "tp" if tp_live else None, None))
+    in_specs = (
+        P(None, None),   # tokens (replicated slot batch)
+        P(None, None),   # tok_of_slot (global tables)
+        P(None, None),   # slot_valid
+        P(None, None),   # slot_of_tok
+        P(None, None),   # w_of_tok
+        w_specs[0],
+    ) + ((w_specs[0],) if wg is not None else ()) + (w_specs[1],)
+    out_spec = P(axis, None)  # each member emits its own token block
+
+    def body(tok, tof, sv, sot, wt, *ws):
+        ws = list(ws)
+        wi_l = ws.pop(0)
+        wg_l = ws.pop(0) if wg is not None else None
+        wo_l = ws.pop(0)
+        i = lax.axis_index(axis).astype(jnp.int32)
+        # dispatch = local slicing: my experts' capacity rows out of the
+        # replicated token copy (invalid slots zeroed exactly like the
+        # stock path's slot_valid mask)
+        my_tok = lax.dynamic_slice(tof, (i * E_loc, 0), (E_loc, C))
+        my_valid = lax.dynamic_slice(sv, (i * E_loc, 0), (E_loc, C))
+        rows = jnp.take(tok, my_tok.reshape(-1), axis=0).reshape(
+            E_loc, C, D
+        )
+        rows = rows * my_valid[..., None].astype(tok.dtype)
+
+        def ffn(chunk):
+            # the serial path's expert matmuls on the landed capacity
+            # rows (rows independent — chunking is pure scheduling)
+            h = jnp.einsum("ecd,edf->ecf", chunk, wi_l)
+            if wg_l is not None:
+                h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", chunk, wg_l)) * h
+            else:
+                h = jax.nn.gelu(h)
+            eo = jnp.einsum("ecd,edf->ecf", h, wo_l)
+            if tp_live:
+                eo = lax.psum(eo, "tp")
+            return eo
+
+        fwd, bwd = _ring_perms(ep)
+        full = jnp.zeros((E, C, D), tok.dtype)
+        for c0, cw in chunk_list:
+            eo = ffn(rows[:, c0:c0 + cw])
+            if not bidirectional or cw < 2:
+                buf = eo
+                for s in range(ep):
+                    blk = (i - s) % ep
+                    full = lax.dynamic_update_slice(
+                        full, buf, (blk * E_loc, c0, 0)
+                    )
+                    if s < ep - 1:
+                        buf = _hop(buf, axis, fwd)
+            else:
+                wa = cw - cw // 2
+                buf_a, buf_b = eo[:, :wa], eo[:, wa:]
+                for s in range(ep):
+                    full = lax.dynamic_update_slice(
+                        full, buf_a, (((i - s) % ep) * E_loc, c0, 0)
+                    )
+                    full = lax.dynamic_update_slice(
+                        full, buf_b, (((i + s) % ep) * E_loc, c0 + wa, 0)
+                    )
+                    if s < ep - 1:
+                        buf_a = _hop(buf_a, axis, fwd)
+                        buf_b = _hop(buf_b, axis, bwd)
+        # combine MY token block with the stock path's exact expression
+        # (the assembled full tensor is member-identical; the output spec
+        # claims only the block each member actually owns)
+        my_sot = lax.dynamic_slice(sot, (i * N_loc, 0), (N_loc, K))
+        my_w = lax.dynamic_slice(wt, (i * N_loc, 0), (N_loc, K))
+        picked = jnp.take(
+            full.reshape(E * C, D), my_sot.reshape(-1), axis=0
+        ).reshape(N_loc, K, D)
+        return jnp.sum(picked * my_w[..., None].astype(tok.dtype), axis=1)
+
+    args = (tokens, tok_of_slot, slot_valid, slot_of_tok, w_of_tok, wi) + (
+        (wg,) if wg is not None else ()
+    ) + (wo,)
+    return _shard_map_full(body, topo, in_specs, out_spec)(*args)
+
+
+def moe_decode_a2a_applicable(topo, *, E: int, F: int,
+                              n_tokens: Optional[int] = None) -> bool:
+    """Shape half of the decode-ring predicate (the ``a2a_scope`` being
+    active is the other half): an ep axis exists, experts divide it, tp
+    divides the FFN width, the token count divides ep (each member
+    combines its own block), the slot batch really is replicated (no
+    live dp/fsdp/sp/pp axes — the serving mesh), and tracing is not
+    already inside a manual shard_map."""
+    if topo is None or topo.sizes.get("ep", 1) <= 1:
+        return False
+    if E % topo.sizes["ep"] != 0:
+        return False
+    if topo.tp_size > 1 and F % topo.tp_size != 0:
+        return False
+    if n_tokens is not None and n_tokens % topo.sizes["ep"] != 0:
+        return False
+    if any(topo.sizes.get(a, 1) > 1 for a in ("dp", "fsdp", "sp", "pp")):
+        return False
+    if _in_manual_context(topo):
+        return False
+    return True
+
+
+def moe_decode_a2a_bytes_per_step(model_cfg, topo, token_budget: int,
+                                  itemsize: int = 2) -> Optional[dict]:
+    """Analytic per-device wire bytes of ONE serving step's expert
+    exchange (the combine ride: every member receives the other ep − 1
+    members' [E/ep, C, D] output blocks, per layer). Honest for BOTH
+    forms — the stock path's all-gather moves the same logical volume in
+    one collective; the chunked ring moves it as ppermute hops that hide
+    under the per-chunk FFNs. None for non-MoE models or ep == 1."""
+    E = int(getattr(model_cfg, "num_experts", 0) or 0)
+    ep = topo.sizes.get("ep", 1)
+    if E <= 0 or ep <= 1 or E % ep != 0:
+        return None
+    if token_budget <= 0:
+        return None
+    from ..moe.sharded_moe import eval_capacity
+
+    capacity = eval_capacity(model_cfg, int(token_budget))
+    d = model_cfg.hidden_size
+    hops = ep - 1
+    per_layer = (E // ep) * capacity * d * itemsize * hops
+    total = per_layer * model_cfg.num_layers
+    return {
+        "bytes_per_step": total,
+        "capacity": capacity,
+        "hops_per_exchange": hops,
+    }
 
 
 # ------------------------------------------------------------- applicability

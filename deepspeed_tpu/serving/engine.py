@@ -177,6 +177,116 @@ def paged_kv_stream(cfg, num_pages: int, page_size: int, max_slots: int,
     }
 
 
+# the "auto" moe_a2a form's payload threshold: below this many bytes per
+# ring hop the exchange is latency-bound (The Big Send-off's small-message
+# regime) and stock collectives win; above it the chunked ppermute ride
+# can hide under the per-chunk expert FFNs. Static per engine — the form
+# never changes at run time, so neither does the compiled program.
+MOE_A2A_AUTO_THRESHOLD_BYTES = 1 << 20
+# ring granularity of the serving chunked form (capacity chunks whose
+# hops pipeline against each other) — fixed pending an on-chip A/B; ONE
+# constant so the engine and the lint trace cannot diverge
+MOE_A2A_CHUNKS = 2
+
+
+def serving_ep_size(moe_section, mcfg) -> int:
+    """The ep mesh degree a MoE serving config serves (and lints) on:
+    ``moe.ep_size`` clamped to what divides the experts; 1 for dense
+    models. ONE clamp shared by trace_serving_step and
+    analysis.lint_serving_config."""
+    if not getattr(mcfg, "is_moe", False):
+        return 1
+    ep = max(int(getattr(moe_section, "ep_size", 1)), 1)
+    if ep > 1 and mcfg.num_experts % ep != 0:
+        return 1
+    return ep
+
+
+def resolve_moe_a2a_form(serving_moe_a2a: str, mcfg, topology,
+                         token_budget: int, itemsize: int,
+                         packed_experts: bool = False,
+                         max_slots: Optional[int] = None) -> str:
+    """Resolve serving.moe_a2a ("auto"|"stock"|"chunked") into the form
+    the step will actually trace: "off" (dense model or no ep axis),
+    "stock" (GSPMD collectives) or "chunked" (the decode-shaped
+    chunked-ppermute ring, parallel/a2a_overlap.moe_decode_a2a). ONE
+    resolution shared by ServingEngine and the shardlint serving trace,
+    so the linted program is the served program — including the
+    slot-grid divisibility gate (``max_slots`` when known: the ring
+    needs max_slots · token_budget to divide ep, and the declared form
+    must describe the exchange that actually runs). The planner's
+    serving moe-a2a axis enumerates stock vs chunked explicitly."""
+    if not getattr(mcfg, "is_moe", False):
+        return "off"
+    if topology.sizes.get("ep", 1) <= 1:
+        return "stock"  # dense-replicated experts: nothing on the wire
+    from ..parallel.a2a_overlap import moe_decode_a2a_applicable
+
+    applicable = (
+        not packed_experts
+        and moe_decode_a2a_applicable(
+            topology, E=mcfg.num_experts, F=mcfg.ffn,
+            n_tokens=(
+                int(max_slots) * int(token_budget)
+                if max_slots is not None else None
+            ),
+        )
+    )
+    form = serving_moe_a2a
+    if form == "auto":
+        from ..moe.sharded_moe import eval_capacity
+
+        cap = eval_capacity(mcfg, int(token_budget))
+        per_hop = (
+            (mcfg.num_experts // topology.sizes["ep"]) * cap
+            * mcfg.hidden_size * itemsize
+        )
+        form = (
+            "chunked" if per_hop >= MOE_A2A_AUTO_THRESHOLD_BYTES
+            else "stock"
+        )
+    if form == "chunked" and not applicable:
+        form = "stock"
+    return form
+
+
+def moe_a2a_scope_cfg(form: str):
+    """The a2a_scope config the serving step traces under (enabled only
+    for the chunked form; a DISABLED cfg forces the stock exchange so an
+    ambient training scope can never leak in). ONE construction shared
+    by ServingEngine and trace_serving_step."""
+    from ..config import MoEOverlapA2AConfig
+
+    return MoEOverlapA2AConfig(enabled=form == "chunked",
+                               chunks=MOE_A2A_CHUNKS)
+
+
+def moe_decode_stream(mcfg, topology, token_budget: int, itemsize: int,
+                      form: str) -> Optional[Dict[str, Any]]:
+    """The ``moe_decode_a2a`` analytic stream dict (None when no expert
+    exchange exists: dense model or ep == 1) — ONE construction shared
+    by ServingEngine.analytic_streams and trace_serving_step, so the
+    R8-priced stream always describes the served exchange."""
+    ep = topology.sizes.get("ep", 1)
+    if not getattr(mcfg, "is_moe", False) or ep <= 1:
+        return None
+    from ..parallel.a2a_overlap import moe_decode_a2a_bytes_per_step
+
+    ring = moe_decode_a2a_bytes_per_step(
+        mcfg, topology, int(token_budget), itemsize=itemsize,
+    )
+    if not ring:
+        return None
+    return {
+        **ring,
+        "kind": "ici",
+        "per_device_bytes_per_step": ring["bytes_per_step"],
+        "overlapped": form == "chunked",
+        "form": form,
+        "ep": ep,
+    }
+
+
 def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
                  max_draft: int = 0):
     """The ONE serving step (pure; jitted by ServingEngine, traced
@@ -204,18 +314,37 @@ def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
     window to the pre-spec single-token sampling tail, bitwise.
 
     Returns (caches, seen, out_tokens [N, max_draft + 1] i32,
-    n_emit [N] i32, new_rng [N, 2]).
+    n_emit [N] i32, new_rng [N, 2]) — MoE models append a sixth
+    ``moe_stats`` output (tokens-per-expert/routed/dropped counters; the
+    arity is static per engine).
+
+    MoE models route the MLP through the expert-parallel serving path:
+    ``pos < num_new`` marks each row's REAL tokens, so padded tails,
+    idle slots and done rows route to the null expert and capacity stays
+    a constant of the static token budget W (the scheduler never packs
+    more than W real tokens per step) — occupancy changes recompile
+    nothing.
     """
     sample_one = _make_sample_one(vocab)
+    moe = bool(getattr(cfg, "is_moe", False))
 
     def step(params, caches, seen, tokens, num_new, start_pos, fresh,
              sample_flag, spec_len, eos_id, rng, temperature, top_k, top_p,
              rep_penalty):
         live = sample_flag & (num_new > 0)
         seen = _book_seen(seen, tokens, num_new, spec_len, fresh, vocab)
-        logits, caches = forward_with_cache(
-            cfg, params, tokens, caches, start_pos, dtype=dtype
+        token_valid = (
+            jnp.arange(tokens.shape[1])[None, :] < num_new[:, None]
+            if moe else None
         )
+        fw = forward_with_cache(
+            cfg, params, tokens, caches, start_pos, dtype=dtype,
+            token_valid=token_valid, return_moe_stats=moe,
+        )
+        if moe:
+            logits, caches, moe_stats = fw
+        else:
+            logits, caches = fw
         if cache_shardings is not None:
             # keep the donated arena carry sharding-closed across steps
             caches = jax.lax.with_sharding_constraint(
@@ -225,6 +354,8 @@ def make_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
             sample_one, logits, tokens, seen, num_new, spec_len, live, rng,
             temperature, top_k, top_p, rep_penalty, eos_id, max_draft,
         )
+        if moe:
+            return caches, seen, out_tok, n_emit, new_rng, moe_stats
         return caches, seen, out_tok, n_emit, new_rng
 
     return step
@@ -267,6 +398,7 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
     views) through the tables, so every arrival/sharing/divergence mix
     runs the same compiled program — zero recompiles after warmup."""
     sample_one = _make_sample_one(vocab)
+    moe = bool(getattr(cfg, "is_moe", False))
 
     def step(params, caches, seen, tokens, num_new, start_pos, page_table,
              cow_src, fresh, sample_flag, spec_len, eos_id, rng, temperature,
@@ -274,10 +406,19 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
         live = sample_flag & (num_new > 0)
         seen = _book_seen(seen, tokens, num_new, spec_len, fresh, vocab)
         caches = paged_cow_copy(caches, page_table, start_pos, cow_src)
-        logits, caches = forward_with_cache(
+        token_valid = (
+            jnp.arange(tokens.shape[1])[None, :] < num_new[:, None]
+            if moe else None
+        )
+        fw = forward_with_cache(
             cfg, params, tokens, caches, start_pos, dtype=dtype,
             page_table=page_table,
+            token_valid=token_valid, return_moe_stats=moe,
         )
+        if moe:
+            logits, caches, moe_stats = fw
+        else:
+            logits, caches = fw
         if cache_shardings is not None:
             # keep the donated pool carry sharding-closed across steps
             caches = jax.lax.with_sharding_constraint(
@@ -287,6 +428,8 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
             sample_one, logits, tokens, seen, num_new, spec_len, live, rng,
             temperature, top_k, top_p, rep_penalty, eos_id, max_draft,
         )
+        if moe:
+            return caches, seen, out_tok, n_emit, new_rng, moe_stats
         return caches, seen, out_tok, n_emit, new_rng
 
     return step
@@ -353,6 +496,33 @@ class ServingEngine:
         # per-request cap; the +W margin absorbs the chunk a full slot
         # writes past its frontier (padding rows, never attendable)
         self.max_tokens = min(serving.max_tokens, engine.max_tokens)
+        # ---- MoE serving (ISSUE 14): expert-parallel decode ------------
+        # the step routes the MLP through the slot-ragged expert path;
+        # under an ep mesh axis the expert exchange takes the form
+        # resolved here (ONE resolution shared with the shardlint trace)
+        mcfg = engine.config
+        self.moe_serving = bool(getattr(mcfg, "is_moe", False))
+        self.moe_ep = self.topology.sizes.get("ep", 1)
+        self._a2a_cfg = None
+        self.moe_a2a_form = "off"
+        if self.moe_serving:
+            from ..ops.quantizer import PackedWeight
+
+            packed_experts = any(
+                isinstance(leaf, PackedWeight) and len(leaf.shape) == 4
+                for leaf in jax.tree_util.tree_leaves(
+                    engine.params,
+                    is_leaf=lambda a: isinstance(a, PackedWeight),
+                )
+            )
+            self.moe_a2a_form = resolve_moe_a2a_form(
+                serving.moe_a2a, mcfg, self.topology, W,
+                jnp.dtype(engine.dtype).itemsize,
+                packed_experts=packed_experts, max_slots=N,
+            )
+            # the scope is entered around every step call (trace-time
+            # protocol)
+            self._a2a_cfg = moe_a2a_scope_cfg(self.moe_a2a_form)
         self.paged = bool(serving.paged)
         if self.paged:
             from ..config import DeepSpeedConfigError
@@ -501,6 +671,16 @@ class ServingEngine:
         # compile per distinct transferred-page count, bounded by
         # pages_per_slot)
         self._import_pages_fn = None
+        # static per-step wire bytes of the expert exchange (0 without an
+        # ep axis) — fed to the metrics counters and declared as the
+        # moe_decode_a2a analytic stream (R8 prices it)
+        self._moe_a2a_step_bytes = 0
+        stream = moe_decode_stream(
+            self.config, self.topology, W,
+            jnp.dtype(self.dtype).itemsize, self.moe_a2a_form,
+        )
+        if stream:
+            self._moe_a2a_step_bytes = int(stream["bytes_per_step"])
         arena = (
             f"pages={self.num_pages}x{self.page_size}tok "
             f"({self.pages_per_slot}/slot)"
@@ -512,6 +692,10 @@ class ServingEngine:
             f"{'int8' if engine.kv_cache_quantized else jnp.dtype(engine.kv_cache_storage_dtype).name}, "
             f"tp={self.topology.tp_size}, spec="
             f"{f'ngram(k<={self.max_draft})' if self.max_draft else 'off'}"
+            + (
+                f", moe=ep{self.moe_ep}/{self.moe_a2a_form}"
+                if self.moe_serving else ""
+            )
         )
         if self.healthwatch is not None:
             # price comm-exposed goodput off the declared streams (only
@@ -625,8 +809,12 @@ class ServingEngine:
             ).astype(np.int32)
             paged_args = ()
         traces_before = self.step_traces
-        with use_topology(self.topology), self.engine._impl_ctx():
-            caches, seen, out_tok, n_emit, new_rng = self._step(
+        from ..parallel.a2a_overlap import a2a_scope
+
+        moe_stats = None
+        with use_topology(self.topology), self.engine._impl_ctx(), \
+                a2a_scope(self._a2a_cfg):
+            outs = self._step(
                 self.engine.params, self._caches, self._seen,
                 jnp.asarray(plan.tokens), jnp.asarray(plan.num_new),
                 jnp.asarray(start_pos), *paged_args,
@@ -635,6 +823,10 @@ class ServingEngine:
                 jnp.asarray(rng), jnp.asarray(temp), jnp.asarray(top_k),
                 jnp.asarray(top_p), jnp.asarray(penalty),
             )
+        if self.moe_serving:
+            caches, seen, out_tok, n_emit, new_rng, moe_stats = outs
+        else:
+            caches, seen, out_tok, n_emit, new_rng = outs
         if dispatch_sp is not None:
             dispatch_sp.annotate(traced=self.step_traces - traces_before)
             dispatch_sp.end()
@@ -656,6 +848,14 @@ class ServingEngine:
             n_emit=np.asarray(n_emit),
         )
         self.metrics.on_step()
+        if moe_stats is not None:
+            # expert load-balance counters (ISSUE 14 satellite): the step
+            # already computed them on device — one tiny [E] transfer
+            self.metrics.on_moe(
+                np.asarray(moe_stats["tokens_per_expert"]),
+                float(moe_stats["drop_fraction"]),
+                a2a_bytes=self._moe_a2a_step_bytes,
+            )
         if self.comm_logger is not None:
             self.comm_logger.record_streams(self.analytic_streams())
         if tr is not None:
@@ -781,6 +981,16 @@ class ServingEngine:
                 self.engine.kv_cache_quantized,
                 tp=self.topology.tp_size,
             )
+        # the decode-shaped expert exchange (combine ride): the stock
+        # form moves it as one all-gather (exposed), the chunked form as
+        # ppermute hops declared overlapped — R8 statically checks the
+        # hops fit the compute window
+        moe_stream = moe_decode_stream(
+            self.config, self.topology, self.token_budget,
+            jnp.dtype(self.dtype).itemsize, self.moe_a2a_form,
+        )
+        if moe_stream:
+            streams["moe_decode_a2a"] = moe_stream
         return streams
 
 
@@ -800,12 +1010,17 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     )
     srv = cfg.serving
     tp = max(int(cfg.tensor_parallel.tp_size), 1)
+    mcfg = model.config
+    # MoE serving configs lint on the ep mesh they would serve on: the
+    # expert exchange only exists in the traced program when the ep axis
+    # does (serving_ep_size — the ONE moe.ep_size clamp)
+    ep = serving_ep_size(cfg.moe, mcfg)
     if topology is None:
         topology = MeshTopology(
-            dims=ParallelDims(tp=tp), devices=jax.devices()[:tp]
+            dims=ParallelDims(tp=tp, ep=ep),
+            devices=jax.devices()[:tp * ep],
         )
     mesh = topology.mesh
-    mcfg = model.config
     dtype = cfg.compute_dtype
     quantized = srv.kv_cache_dtype == "int8"
     storage = jnp.bfloat16 if srv.kv_cache_dtype in ("bf16", "bfloat16") \
@@ -897,7 +1112,20 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     make_fn = make_paged_step_fn if paged else make_step_fn
     step_fn = make_fn(mcfg, dtype, V, cache_shardings=cache_shardings,
                       max_draft=max_draft)
-    with use_topology(topology):
+    # the traced program IS the served program: resolve the expert-
+    # exchange form exactly like ServingEngine.__init__ and enter the
+    # scope around the trace (R3 then lints the ring's perms when the
+    # chunked form is resolved)
+    moe_form = resolve_moe_a2a_form(
+        srv.moe_a2a, mcfg, topology, W, jnp.dtype(dtype).itemsize,
+        max_slots=N,
+    )
+    a2a_cfg = (
+        moe_a2a_scope_cfg(moe_form)
+        if getattr(mcfg, "is_moe", False) else None
+    )
+    from ..parallel.a2a_overlap import a2a_scope
+    with use_topology(topology), a2a_scope(a2a_cfg):
         closed = jax.make_jaxpr(step_fn)(*args)
     flat = jax.tree_util.tree_leaves(args)
     invars = list(closed.jaxpr.invars)
@@ -926,4 +1154,9 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
             mcfg, N, max_draft, jnp.dtype(storage).itemsize, quantized,
             tp=tp,
         )
+    moe_stream = moe_decode_stream(
+        mcfg, topology, W, jnp.dtype(dtype).itemsize, moe_form,
+    )
+    if moe_stream:
+        streams["moe_decode_a2a"] = moe_stream
     return closed, arg_shardings, streams

@@ -109,6 +109,15 @@ class ServingMetrics:
         self.draft_tokens_accepted = 0
         self.spec_tokens_out = 0      # tokens emitted by verify windows
         #   (accepted drafts + bonus tokens)
+        # MoE serving (expert-parallel decode, ISSUE 14)
+        self.moe_steps = 0            # steps that routed through experts
+        self.moe_tokens_per_expert: List[int] = []  # cumulative histogram
+        #   of capacity slots landed per expert (summed over layers)
+        self.moe_routed_tokens = 0    # token-expert assignments kept
+        self.moe_dropped_fraction = 0.0  # last step's dropped fraction
+        #   (valid token-expert assignments that overflowed capacity)
+        self.moe_a2a_bytes = 0        # cumulative expert-exchange wire
+        #   bytes (the analytic moe_decode_a2a stream; 0 without ep)
         # gauges (last observed)
         self.queue_depth = 0
         self.slot_occupancy = 0.0
@@ -202,6 +211,37 @@ class ServingMetrics:
         else:
             self.prefill_chunks += 1
 
+    def on_moe(self, tokens_per_expert, dropped_fraction,
+               a2a_bytes: int = 0) -> None:
+        """One MoE serving step's expert load-balance counters (ISSUE 14
+        satellite): ``tokens_per_expert`` is the step's [E] capacity-slot
+        histogram (summed over layers), ``dropped_fraction`` the valid
+        token-expert assignments that overflowed capacity, ``a2a_bytes``
+        the analytic expert-exchange wire bytes. NaN-hardened like the
+        TTFT percentiles — a poisoned device value can never reach the
+        summary line or the serve/* bridge."""
+        self.moe_steps += 1
+        hist = [int(_finite(v)) for v in list(tokens_per_expert)]
+        if len(self.moe_tokens_per_expert) != len(hist):
+            self.moe_tokens_per_expert = [0] * len(hist)
+        self.moe_tokens_per_expert = [
+            a + b for a, b in zip(self.moe_tokens_per_expert, hist)
+        ]
+        self.moe_routed_tokens += sum(hist)
+        self.moe_dropped_fraction = float(_finite(dropped_fraction))
+        self.moe_a2a_bytes += int(_finite(a2a_bytes))
+
+    @property
+    def moe_load_imbalance(self) -> float:
+        """max/mean of the cumulative tokens-per-expert histogram — 1.0
+        is perfect balance, E is total collapse onto one expert; 0.0
+        before any MoE step ran."""
+        hist = self.moe_tokens_per_expert
+        total = sum(hist)
+        if not hist or total <= 0:
+            return 0.0
+        return max(hist) / (total / len(hist))
+
     def on_pages(self, pool, cache_entries: int = 0) -> None:
         """Pool gauges from the scheduler's PagePool after a tick."""
         self.pages_free = pool.free_count
@@ -286,6 +326,20 @@ class ServingMetrics:
             "mean_accepted_tokens_per_step":
                 self.mean_accepted_tokens_per_step,
         }
+        if self.moe_steps:
+            snap.update({
+                "moe_steps": self.moe_steps,
+                "moe_routed_tokens": self.moe_routed_tokens,
+                "moe_dropped_fraction": self.moe_dropped_fraction,
+                "moe_load_imbalance": self.moe_load_imbalance,
+                "moe_a2a_bytes": self.moe_a2a_bytes,
+            })
+            # the per-expert histogram rides the snapshot (and the
+            # serve/* bridge) as bounded scalar keys — E is small
+            snap.update({
+                f"moe_tokens_expert_{i}": v
+                for i, v in enumerate(self.moe_tokens_per_expert)
+            })
         if self.healthwatch is not None:
             snap["goodput"] = self.healthwatch.goodput_fraction()
         # empty-window hardening: every reported value is finite — no
@@ -330,6 +384,15 @@ class ServingMetrics:
                 f"{self.draft_tokens_proposed} drafts), mean accepted "
                 f"tokens/step {self.mean_accepted_tokens_per_step:.2f} "
                 f"over {self.spec_steps} verify windows"
+            )
+        if self.moe_steps:
+            hist = "/".join(str(v) for v in self.moe_tokens_per_expert)
+            lines.append(
+                f"{'moe serving':<18}tokens/expert [{hist}] over "
+                f"{self.moe_steps} steps, load imbalance "
+                f"{self.moe_load_imbalance:.2f}, dropped "
+                f"{self.moe_dropped_fraction:.3f}, a2a "
+                f"{self.moe_a2a_bytes / (1 << 20):.2f} MiB"
             )
         if self.evict_reasons:
             reasons = ", ".join(

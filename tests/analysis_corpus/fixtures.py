@@ -16,6 +16,9 @@ trace-ready evidence of one statically-visible bug class:
 - ``moe_a2a_malformed_ring`` R3: a hand-rolled MoE dispatch-reduce ring
   whose ep cycle closes on the wrong member (the a2a-overlap hazard;
   the clean twin traces the real parallel/a2a_overlap.py program)
+- ``moe_decode_ring_malformed`` R3: the serving engine's decode-shaped
+  expert combine ride hand-rolled with a duplicate-destination ep perm
+  (the clean twin traces the real moe_decode_a2a ring)
 - ``read_after_donate``     R4: a rotating slot read after overwrite
 - ``zero3_prefetch_stale_slot`` R4: a hand-rolled two-slot param-gather
   prefetch whose layer compute reads the pre-overwrite slot generation
@@ -497,6 +500,78 @@ def moe_a2a_ring_clean():
     )
 
 
+# ------------------------------------------------------------------ R3 ter
+# decode-shaped MoE exchange (ISSUE 14, parallel/a2a_overlap.moe_decode_a2a
+# — the serving engine's expert-parallel combine ride): the hazard is the
+# same ride hand-rolled with a raw lax.ppermute whose ep cycle maps two
+# members onto one destination (the exchange hangs on real ICI); the clean
+# twin traces the REAL decode ring, whose every hop goes through
+# comm.collectives.permute's construction-time R3 contract
+def _moe_decode_topo():
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    return MeshTopology(dims=ParallelDims(ep=4), devices=jax.devices()[:4])
+
+
+def moe_decode_ring_malformed():
+    topo = _moe_decode_topo()
+    ep, E_loc, C, D = 4, 1, 8, 16
+    # ring 0→1→2→3 closed back to 1 instead of 0: duplicate destination —
+    # two members send their expert-output block to one, the combine ride
+    # hangs on real ICI
+    perm = [(0, 1), (1, 2), (2, 3), (3, 1)]
+
+    def body(eo_local):
+        i = lax.axis_index("ep")
+        full = jnp.zeros((ep * E_loc, C, D), eo_local.dtype)
+        buf = eo_local
+        for s in range(ep):
+            blk = (i - s) % ep
+            full = lax.dynamic_update_slice(full, buf, (blk * E_loc, 0, 0))
+            if s < ep - 1:
+                buf = lax.ppermute(buf, "ep", perm)
+        return full
+
+    fn = shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=(P("ep", None, None),),
+        out_specs=P(None, None, None),
+        axis_names=set(topo.mesh.axis_names),
+        check_vma=False,
+    )
+    eo = jax.ShapeDtypeStruct((ep * E_loc, C, D), jnp.float32)
+    return jax.make_jaxpr(fn)(eo), {"mesh": topo.mesh}, "R3"
+
+
+def moe_decode_ring_clean():
+    from deepspeed_tpu.parallel.a2a_overlap import moe_decode_a2a
+
+    topo = _moe_decode_topo()
+    N, D, F, E, C, K = 12, 16, 32, 4, 8, 2
+
+    def prog(tokens, tok_of_slot, slot_valid, slot_of_tok, w_of_tok,
+             wi, wg, wo):
+        return moe_decode_a2a(
+            tokens, tok_of_slot, slot_valid, slot_of_tok, w_of_tok,
+            (wi, wg, wo), topo, chunks=2, bidirectional=True,
+        )
+
+    tokens = jax.ShapeDtypeStruct((N, D), jnp.float32)
+    tof = jax.ShapeDtypeStruct((E, C), jnp.int32)
+    sv = jax.ShapeDtypeStruct((E, C), jnp.bool_)
+    sot = jax.ShapeDtypeStruct((N, K), jnp.int32)
+    wt = jax.ShapeDtypeStruct((N, K), jnp.float32)
+    wi = jax.ShapeDtypeStruct((E, D, F), jnp.float32)
+    wg = jax.ShapeDtypeStruct((E, D, F), jnp.float32)
+    wo = jax.ShapeDtypeStruct((E, F, D), jnp.float32)
+    return (
+        jax.make_jaxpr(prog)(tokens, tof, sv, sot, wt, wi, wg, wo),
+        {"mesh": topo.mesh},
+        "R3",
+    )
+
+
 # ------------------------------------------------------------------ R4 bis
 def _prefetch_slots(stale_read: bool):
     """A hand-rolled two-slot ZeRO-3 gather prefetch: the rotating slot
@@ -776,6 +851,7 @@ HAZARDS = [
     pinned_host_compute,
     tp_overlap_malformed_ring,
     moe_a2a_malformed_ring,
+    moe_decode_ring_malformed,
     zero3_prefetch_stale_slot,
     grad_wire_truncates_master,
     hier_wire_bad_split,
@@ -797,6 +873,7 @@ CLEAN_TWINS = [
     pinned_host_compute_clean,
     tp_overlap_ring_clean,
     moe_a2a_ring_clean,
+    moe_decode_ring_clean,
     zero3_prefetch_stale_slot_clean,
     grad_wire_truncates_master_clean,
     hier_wire_bad_split_clean,

@@ -795,9 +795,10 @@ def test_int4_odd_block_falls_back_to_bytewise():
 
 
 def test_moe_quantized_serving_runs():
-    """MoE + weight quantization: expert banks [L, E, d, f] take the
-    fake-quant path (the batched expert einsums consume dense weights) and
-    the dense leaves still pack — serving runs end-to-end either way."""
+    """MoE + weight quantization: expert banks [L, E, d, f] PACK since
+    ISSUE 14 (the decode dispatch path consumes PackedWeight through the
+    per-expert Pallas matvec / dequantize-once fallback) — serving runs
+    end-to-end with the banks resident as int8 bytes."""
     from deepspeed_tpu.models import mixtral
     from deepspeed_tpu.ops.quantizer import PackedWeight
 
@@ -811,8 +812,8 @@ def test_moe_quantized_serving_runs():
     leaves = jax.tree_util.tree_leaves(
         eng.params, is_leaf=lambda x: isinstance(x, PackedWeight))
     packed = [l for l in leaves if isinstance(l, PackedWeight)]
-    assert packed  # attention projections still pack
-    assert all(len(pw.shape) <= 3 for pw in packed)  # experts excluded
+    assert packed  # attention projections pack
+    assert any(len(pw.shape) == 4 for pw in packed)  # expert banks too
     prompt = np.random.RandomState(9).randint(0, 128, size=(1, 6))
     out = eng.generate(prompt, max_new_tokens=6, temperature=0.0)
     assert out.shape == (1, 12)

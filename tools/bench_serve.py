@@ -31,6 +31,18 @@ continuous-batching serving engine on a CPU mesh.
                                                      # replica handing KV
                                                      # to decode replicas
                                                      # as page transfers
+    python tools/bench_serve.py --model mixtral --ep 2 --check-moe-parity
+                                                     # MoE serving: tiny
+                                                     # mixtral (4 experts,
+                                                     # hidden 256) with the
+                                                     # experts ep-sharded
+                                                     # across 2 devices;
+                                                     # the inline oracle
+                                                     # replays the same
+                                                     # trace dense-
+                                                     # replicated and
+                                                     # requires token-for-
+                                                     # token equality
 
 Arrivals land on a VIRTUAL clock (exponential inter-arrival gaps at
 ``--rate`` requests/s); each engine step advances the clock by its
@@ -118,12 +130,71 @@ def _serving_section(args) -> dict:
         "page_size": args.page_size,
         "num_pages": args.num_pages,
         "prefix_cache": not args.no_prefix_cache,
+        "moe_a2a": args.moe_a2a,
         "spec": {
             "enabled": args.spec,
             "max_draft": args.max_draft,
             "ngram_n": args.ngram_n,
         },
     }
+
+
+def _build_model(args):
+    """The replay model: tiny llama (default) or the tiny mixtral MoE
+    preset (4 experts, hidden 256 — the ISSUE 14 CI leg shape)."""
+    if args.model == "mixtral":
+        from deepspeed_tpu.models import mixtral
+
+        return mixtral(
+            "mixtral-tiny", vocab_size=args.vocab, max_seq_len=64,
+            hidden_size=256, num_layers=2, num_heads=4, num_kv_heads=4,
+            intermediate_size=512, num_experts=4, moe_top_k=2,
+        )
+    from deepspeed_tpu.models import llama
+
+    return llama(
+        "llama-tiny", vocab_size=args.vocab, max_seq_len=64, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=4, intermediate_size=128,
+    )
+
+
+def _moe_parity_replay(args, trace):
+    """The inline ep == dense oracle (--check-moe-parity): replay the
+    same trace through a DENSE-REPLICATED engine (no ep axis, same
+    params rng) and return {request_id: tokens}. Expert-parallel serving
+    must reproduce it token-for-token — sharding the experts is a layout
+    decision, never a numerics one."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.serving import Request, ServingEngine, ServingMetrics
+
+    model = _build_model(args)
+    eng = deepspeed_tpu.init_inference(
+        model, dtype=jnp.float32, max_tokens=64,
+        quantize_bits=args.quantize_bits,
+        kv_cache_dtype=args.kv_cache_dtype,
+        rng=jax.random.PRNGKey(args.seed),
+    )
+    clock = VirtualClock()
+    srv = ServingEngine(engine=eng, clock=clock,
+                        metrics=ServingMetrics(clock=clock),
+                        serving=_serving_section(args))
+    pending = list(trace)
+    finished = []
+    while pending or srv.scheduler.has_work:
+        while pending and pending[0][0] <= clock():
+            at, rid, prompt, new = pending.pop(0)
+            srv.submit(Request(request_id=rid, prompt=prompt,
+                               max_new_tokens=new,
+                               temperature=args.temperature))
+        if not srv.scheduler.has_work:
+            clock.advance(max(pending[0][0] - clock(), 1e-6))
+            continue
+        finished.extend(srv.step())
+        clock.advance(1e-3)  # virtual: parity cares about tokens only
+    return {st.request.request_id: list(st.tokens) for st in finished}
 
 
 def _replay_stats(finished, clock):
@@ -320,6 +391,27 @@ def main(argv=None) -> int:
     ap.add_argument("--min-new", type=int, default=2)
     ap.add_argument("--max-new", type=int, default=12)
     ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--model", default="llama",
+                    choices=["llama", "mixtral"],
+                    help="replay model: tiny llama, or the tiny mixtral "
+                         "MoE preset (4 experts, hidden 256) for "
+                         "expert-parallel serving (--ep)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree: shard the MoE expert "
+                         "banks over an ep mesh axis of this size "
+                         "(--model mixtral; tp*ep CPU host devices)")
+    ap.add_argument("--moe-a2a", default="auto",
+                    choices=["auto", "stock", "chunked"],
+                    help="decode-shaped expert-exchange form under ep>1 "
+                         "(serving.moe_a2a; bitwise-equal forms)")
+    ap.add_argument("--quantize-bits", type=int, default=None,
+                    choices=[4, 8],
+                    help="weight-only quantization incl. the expert banks "
+                         "(packed Pallas streaming matvec)")
+    ap.add_argument("--check-moe-parity", action="store_true",
+                    help="exit 1 unless the ep-sharded replay reproduces "
+                         "a dense-replicated replay of the same trace "
+                         "token-for-token (the ISSUE 14 oracle)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--kv-cache-dtype", default="auto",
                     choices=["auto", "bf16", "int8"])
@@ -408,22 +500,24 @@ def main(argv=None) -> int:
 
     import deepspeed_tpu
     from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
-    from deepspeed_tpu.models import llama
     from deepspeed_tpu.profiling.comm_logger import CommsLogger
     from deepspeed_tpu.serving import Request, ServingEngine, ServingMetrics
 
-    model = llama(
-        "llama-tiny", vocab_size=args.vocab, max_seq_len=64, hidden_size=64,
-        num_layers=2, num_heads=4, num_kv_heads=4, intermediate_size=128,
-    )
+    if args.ep > 1 and args.model != "mixtral":
+        ap.error("--ep > 1 needs --model mixtral (expert parallelism "
+                 "shards MoE expert banks)")
+    model = _build_model(args)
     topology = None
-    if args.tp > 1:
+    if args.tp > 1 or args.ep > 1:
+        n = max(args.tp, 1) * max(args.ep, 1)
         topology = MeshTopology(
-            dims=ParallelDims(tp=args.tp), devices=jax.devices()[:args.tp]
+            dims=ParallelDims(tp=args.tp, ep=max(args.ep, 1)),
+            devices=jax.devices()[:n],
         )
     engine = deepspeed_tpu.init_inference(
         model, dtype=jnp.float32, max_tokens=64, topology=topology,
         kv_cache_dtype=args.kv_cache_dtype,
+        quantize_bits=args.quantize_bits,
         rng=jax.random.PRNGKey(args.seed),
     )
     clock = VirtualClock()
@@ -465,6 +559,7 @@ def main(argv=None) -> int:
         logger.registry = srv.tracer
     trace = build_trace(args)
     pending = list(trace)
+    finished = []
     t_wall0 = time.perf_counter()
     while pending or srv.scheduler.has_work:
         while pending and pending[0][0] <= clock():
@@ -477,7 +572,7 @@ def main(argv=None) -> int:
             clock.advance(max(pending[0][0] - clock(), 1e-6))  # idle: jump
             continue
         t0 = time.perf_counter()
-        srv.step()
+        finished.extend(srv.step())
         clock.advance(time.perf_counter() - t0)
     wall = time.perf_counter() - t_wall0
 
@@ -545,12 +640,33 @@ def main(argv=None) -> int:
             print(f"ERROR: expected health rule(s) never fired: "
                   f"{', '.join(missing)}")
             return 1
+    if args.model == "mixtral":
+        hist = "/".join(
+            str(int(m.get(f"moe_tokens_expert_{i}", 0)))
+            for i in range(model.config.num_experts)
+        )
+        print(
+            f"moe: ep={args.ep} form={srv.moe_a2a_form}, tokens/expert "
+            f"[{hist}], load imbalance {m.get('moe_load_imbalance', 0):.2f}, "
+            f"dropped {m.get('moe_dropped_fraction', 0):.3f}, a2a "
+            f"{m.get('moe_a2a_bytes', 0) / (1 << 20):.2f} MiB"
+        )
     if m["finished"] != args.requests:
         print(f"ERROR: {args.requests - m['finished']} requests unfinished")
         return 1
     if args.check_recompiles and srv.step_traces != 1:
         print("ERROR: the slot step recompiled after warmup")
         return 1
+    if args.check_moe_parity:
+        want = _moe_parity_replay(args, trace)
+        got = {st.request.request_id: list(st.tokens) for st in finished}
+        for rid, toks in want.items():
+            if got.get(rid) != toks:
+                print(f"ERROR: {rid} diverged from the dense-replicated "
+                      f"replay ({got.get(rid)} != {toks})")
+                return 1
+        print(f"moe parity: ep={args.ep} replay == dense-replicated "
+              f"replay token-for-token ({len(want)} requests)")
     if args.check_acceptance:
         if m["acceptance_rate"] <= 0.0:
             print("ERROR: no draft token was ever accepted")
