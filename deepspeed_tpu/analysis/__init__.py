@@ -15,6 +15,18 @@ rule registry:
 - R6 hbm-capacity        (rules/capacity.py — needs an HBM budget)
 - R7 redundant-reshard   (rules/reshard.py)
 - R8 overlap-budget      (rules/overlap_budget.py — needs declared streams)
+- R9 rng-discipline      (rules/rng.py)
+- R10 reduction-order    (rules/reduction_order.py)
+- R11 trace-stability    (rules/trace_stability.py — needs a traced-args
+  manifest)
+
+The sibling :mod:`.parity` module is the differential half of
+R10/parity: :func:`prove_parity` structurally diffs the two traced
+forms of a declared-bitwise pair (paged vs contiguous, moe stock vs
+chunked, TP ring vs XLA reference, wire codec vs full-width) modulo a
+small rewrite-equivalence set and emits either a static parity
+certificate or the first divergent op with both provenances
+(``tools/paritycheck.py``).
 
 The sibling :mod:`.cost` package is the static HBM-capacity +
 collective-cost planner rules R6/R8 consume: :func:`plan_engine` /
@@ -38,17 +50,23 @@ from .cost import (
     plan_engine,
     plan_jaxpr,
 )
+from .parity import (FormPair, ParityCertificate, config_parity_pairs,
+                     prove_parity)
 from .rules import register_rule, registered_rules
 from .shardlint import (lint_config, lint_engine, lint_jaxpr,
                         lint_serving_config)
 
 __all__ = [
     "Finding",
+    "FormPair",
     "HardwareModel",
     "LintContext",
+    "ParityCertificate",
     "Plan",
     "Report",
+    "config_parity_pairs",
     "format_plan_table",
+    "prove_parity",
     "lint_config",
     "lint_engine",
     "lint_jaxpr",

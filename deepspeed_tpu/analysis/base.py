@@ -77,6 +77,22 @@ class LintContext:
     invar_groups: state-group name → flat invar index range, so the
         plan's byte columns split exactly like the engine state.
 
+    RNG / trace-stability evidence (rules R9/R11 — armed by drivers):
+
+    claims_keyfree: the traced program claims key-free bitwiseness (an
+        eval/serving path whose outputs must not depend on any PRNG key
+        — the PR-14 gating contract). When True, R9 flags EVERY
+        key-consuming site; default False (training/sampling programs
+        consume keys legitimately).
+    required_traced: argument names that must be TRACED inputs of the
+        step (per-request/per-tick host state — slot occupancy vectors,
+        spec_len, cow_src). R11 checks each against ``traced_manifest``;
+        empty disables R11 (the default — only the engine/serving trace
+        drivers know the step's argument contract).
+    traced_manifest: argument name → flat top-level invar index range
+        actually traced (same layout as ``invar_groups``; the engine
+        trace reuses invar_groups as its manifest).
+
     (Other donation hazards need no context field: R4 reads each pjit
     equation's own ``donated_invars`` param, and the jit-boundary
     donation audit lives in shardlint.lint_engine, which has the engine.)
@@ -92,6 +108,9 @@ class LintContext:
     hardware: Any = None
     donated_invars: Sequence[int] = ()
     invar_groups: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    claims_keyfree: bool = False
+    required_traced: Sequence[str] = ()
+    traced_manifest: Dict[str, Tuple[int, int]] = field(default_factory=dict)
     _plan: Any = field(default=None, repr=False, compare=False)
 
     @property

@@ -1,0 +1,787 @@
+"""Differential jaxpr parity prover (ISSUE 15).
+
+Every headline claim in this repo — paged == contiguous, ring == XLA
+reference, moe_a2a stock == chunked, wire codec == full-width — is a
+*bitwise-parity* contract between TWO FORMS of one program, proven at
+runtime by CPU-mesh replay oracles. This module proves the structural
+half statically, in seconds, from the two abstract traces alone:
+
+    pair  = one FormPair (two trace thunks + declared rewrite classes)
+    cert  = prove_parity(pair)   # ParityCertificate
+    cert.ok → a static parity certificate: the two forms' COMPUTE
+              ANCHORS (dots, reductions, sampling, RNG consumption,
+              collectives, kernels) agree as multisets modulo the
+              declared rewrite-equivalence classes
+    else   → the first divergent op, with both provenances
+
+What "modulo" means — the rewrite classes a pair may declare:
+
+- ``addressing``   gather/scatter/dynamic-slice traffic is elided: the
+  two forms address the same bytes differently (page tables vs
+  contiguous regions). Content equality is the runtime oracle's job;
+  R2/R4 cover the carry/donation structure.
+- ``chunking``     a compute/reduce anchor may split into k
+  same-shaped chunks (the decomposed-ring sub-matmuls): buckets that
+  disagree are re-checked by per-(op, dtype) mass — count × element
+  volume — which chunking preserves exactly.
+- ``collective_decomposition``  a run of ppermute hops over axis A is
+  one logical collective over A (the R3/R7 laws): collective anchors
+  compare by axis-set presence, not by op spelling or hop count.
+- ``codec``        a wire codec may add scale computations
+  (``reduce_max`` amax chains) and move int8/int4 payloads where the
+  full-width form moves floats: amax reductions are elided and
+  collective payload dtypes are not compared (wire error bounds are
+  the codec's own property-tested contract, docs/wires.md).
+- ``implicit_collectives``  a GSPMD reference form's collectives are
+  inserted at COMPILE time and invisible in its traced jaxpr (the
+  planner's documented bias), so collective anchors present on only
+  the explicit-collective side are folded — the reduction and compute
+  anchors still compare.
+- ``recompute``    a decomposed overlap form may REPLICATE compute to
+  buy wire overlap (the moe a2a ride re-runs expert FFNs per dp
+  member): a compute-family mass ratio up to ``recompute_bound`` is
+  folded; beyond it (or a missing block of work) still diverges.
+
+Anchors NEVER elided: dot_general mass/shape, reduce_sum/cumsum
+grouping, scatter-add (accumulation into shared destinations), RNG
+consumption counts (random_bits/random_split — the R9 chain), sampling
+ops (sort/argmax/top_k), pallas kernel output signatures. A mismatch in
+a reduction/collective/accumulation bucket is labeled rule R10
+(reduction-order: the grouping changed); anything else is labeled
+"parity".
+
+Shapes are normalized: unit dims dropped, dim order sorted (transpose
+normalization), and each form's ``dim_aliases`` map form-specific
+extents (the paged arena's pages·page_size vs the contiguous capacity)
+to shared symbols, so the SAME logical extent spelled differently never
+reads as divergence.
+
+Engines declare their pairs through ``parity_pairs()`` (next to
+``analytic_streams()``); :func:`config_parity_pairs` builds the pairs a
+ds_config declares without constructing a real engine. CLI:
+``tools/paritycheck.py --all-pairs`` (exit 1 on divergence).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .trace import as_jaxpr, collective_axes, eqn_subjaxprs
+
+# ---------------------------------------------------------------- anchors
+# always-transparent ops: elementwise math, layout, casts, literals
+_ELIDE = {
+    "add", "sub", "mul", "div", "neg", "exp", "log", "log1p", "tanh",
+    "logistic", "erf", "erf_inv", "rsqrt", "sqrt", "pow", "integer_pow",
+    "max", "min", "clamp", "select_n", "eq", "ne", "lt", "le", "gt", "ge",
+    "and", "or", "not", "xor", "sign", "floor", "ceil", "round",
+    "is_finite", "abs", "rem", "convert_element_type",
+    "bitcast_convert_type", "broadcast_in_dim", "reshape", "transpose",
+    "squeeze", "expand_dims", "rev", "iota", "copy", "device_put",
+    "stop_gradient", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "concatenate", "pad", "slice",
+    "random_wrap", "random_unwrap", "nextafter", "population_count",
+    "clz", "real", "imag", "square", "cbrt", "atan2", "exp2",
+    # placement/control annotations — schedule shape, not compute
+    "sharding_constraint", "axis_index", "optimization_barrier",
+    # autodiff-inserted accumulation adds (the transpose of fan-out):
+    # elementwise, present wherever a value has two consumers
+    "add_any", "add_n",
+}
+_COMPUTE = {"dot_general", "conv_general_dilated"}
+_REDUCE = {
+    "reduce_sum", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+}
+_REDUCE_EXTREMA = {"reduce_max", "reduce_min", "reduce_and", "reduce_or",
+                   "cummax", "cummin", "argmax", "argmin"}
+_ACCUM = {"scatter-add", "scatter-mul"}
+_SAMPLING = {"sort", "top_k"}
+_RNG = {"random_bits", "random_split", "random_fold_in", "random_seed"}
+_COLLECTIVE = {
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter", "psum_scatter", "pgather", "pbroadcast",
+}
+_ADDRESSING = {
+    "gather", "scatter", "scatter-max", "scatter-min",
+    "dynamic_slice", "dynamic_update_slice",
+}
+_KERNEL = {"pallas_call"}
+
+
+@dataclass
+class Anchor:
+    kind: str            # compute|reduce|accum|sampling|rng|collective|
+    #                      addressing|kernel
+    op: str
+    sig: Tuple           # normalized signature (dtypes + aliased dims)
+    path: str
+    weight: int = 1      # scan-length multiplier
+    mass: float = 0.0    # count-invariant volume (chunk folding)
+    order: int = 0       # first appearance index (divergence reporting)
+
+
+def _dims(aval) -> Tuple:
+    """Sorted non-unit dims — RAW (numeric). Dim aliases apply at
+    compare time (second pass), never at extraction, so a form-specific
+    extent that happens to equal an unrelated model dim cannot smear the
+    alias over anchors the strict pass already matches."""
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    return tuple(sorted(int(d) for d in shape if d != 1))
+
+
+def _volume(aval) -> float:
+    """RAW element volume — like _dims, aliases never touch masses: an
+    unrelated model dim that happens to equal one side's aliased extent
+    must not skew that side's mass (masses only decide the chunking
+    fold, whose families the alias pass has already had its shot at)."""
+    v = 1.0
+    for d in tuple(getattr(aval, "shape", ()) or ()):
+        v *= float(d)
+    return v
+
+
+def _avals(vars_):
+    sig, vol = [], 0.0
+    for v in vars_:
+        aval = getattr(v, "aval", None)
+        if aval is None:
+            continue
+        sig.append((str(getattr(aval, "dtype", "?")), _dims(aval)))
+        vol += _volume(aval)
+    return tuple(sorted(sig)), vol
+
+
+def alias_sig(sig, aliases: Dict[int, str]):
+    """Apply a dim-alias map to a (nested-tuple) signature. Ints map to
+    their shared symbols; every tuple is re-sorted by repr afterwards so
+    both sides canonicalize identically."""
+    if isinstance(sig, int):
+        return aliases.get(sig, sig)
+    if isinstance(sig, tuple):
+        return tuple(sorted(
+            (alias_sig(e, aliases) for e in sig), key=repr
+        ))
+    return sig
+
+
+def extract_anchors(closed_jaxpr, rewrites: frozenset,
+                    dim_aliases: Optional[Dict[int, str]] = None
+                    ) -> List[Anchor]:
+    """Flatten one traced program into its normalized anchor list.
+    ``dim_aliases`` is accepted for signature stability but unused here:
+    aliases apply only in prove_parity's second compare pass."""
+    out: List[Anchor] = []
+    counter = [0]
+
+    def walk(jaxpr, path: str, weight: int) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            sub_w = weight
+            if name == "scan":
+                length = eqn.params.get("length") or 1
+                sub_w = weight * max(int(length), 1)
+            elif name == "shard_map":
+                # the body traces PER-MEMBER local shapes; every manual
+                # member executes it, so logical mass scales by the
+                # manual axis product (the GSPMD twin traces global
+                # shapes once)
+                from .trace import shard_map_manual_axes
+
+                mult = 1
+                for n in shard_map_manual_axes(eqn).values():
+                    mult *= max(int(n), 1)
+                sub_w = weight * mult
+            if name in _KERNEL:
+                # opaque: the kernel's OUTPUT signature is the anchor;
+                # its body is a Mosaic program, not step structure
+                sig, vol = _avals(eqn.outvars)
+                _emit("kernel", name, sig, path, weight, vol)
+                continue
+            subs = eqn_subjaxprs(eqn)
+            if subs:
+                for k, sub in subs:
+                    sub_path = f"{path}/{name}"
+                    if k not in ("jaxpr",):
+                        sub_path += f".{k}"
+                    walk(sub, sub_path, sub_w)
+                continue
+            _classify(eqn, name, path, weight)
+
+    def _emit(kind, op, sig, path, weight, vol):
+        counter[0] += 1
+        out.append(Anchor(kind=kind, op=op, sig=sig, path=path,
+                          weight=weight, mass=vol * weight,
+                          order=counter[0]))
+
+    def _classify(eqn, name, path, weight):
+        if name in _ELIDE:
+            return
+        where = f"{path}/{name}" if path else name
+        if name in _COMPUTE:
+            sig_in, _ = _avals(eqn.invars)
+            sig_out, vol_out = _avals(eqn.outvars)
+            # mass = FLOP proxy (out volume × contraction extent): exact
+            # under both column-chunking (out splits) and row-chunking
+            # (contraction splits), which plain volumes are not
+            contract = 1.0
+            dn = eqn.params.get("dimension_numbers")
+            if name == "dot_general" and dn:
+                (lc, _rc), _batch = dn
+                shape = tuple(
+                    getattr(getattr(eqn.invars[0], "aval", None),
+                            "shape", ()) or ()
+                )
+                for d in lc:
+                    if d < len(shape):
+                        contract *= float(shape[d])
+            _emit("compute", name, (sig_in, sig_out), where, weight,
+                  vol_out * contract)
+            return
+        if name in _REDUCE or name in _REDUCE_EXTREMA:
+            if "codec" in rewrites and name in ("reduce_max", "reduce_min"):
+                return  # codec amax/scale chains
+            kind = "reduce" if name in _REDUCE else "sampling"
+            sig_in, vol_in = _avals(eqn.invars)
+            sig_out, _ = _avals(eqn.outvars)
+            _emit(kind, name, (sig_in, sig_out), where, weight, vol_in)
+            return
+        if name in _ACCUM:
+            sig, vol = _avals(eqn.outvars)
+            _emit("accum", name, sig, where, weight, vol)
+            return
+        if name in _SAMPLING:
+            sig, vol = _avals(eqn.invars)
+            _emit("sampling", name, sig, where, weight, vol)
+            return
+        if name in _RNG:
+            sig, vol = _avals(eqn.outvars)
+            _emit("rng", name, sig, where, weight, vol)
+            return
+        if name in _COLLECTIVE:
+            axes = tuple(sorted(collective_axes(eqn)))
+            if "collective_decomposition" in rewrites:
+                # one logical collective over these axes, any spelling
+                _emit("collective", "collective", (axes,), where, weight,
+                      0.0)
+            else:
+                sig, vol = _avals(eqn.outvars)
+                _emit("collective", name, (axes, sig), where, weight, vol)
+            return
+        if name in _ADDRESSING:
+            if "addressing" in rewrites:
+                return
+            sig, vol = _avals(eqn.outvars)
+            _emit("addressing", name, sig, where, weight, vol)
+            return
+        # unknown primitive: keep it visible (strict by default)
+        sig, vol = _avals(eqn.outvars)
+        _emit("other", name, sig, where, weight, vol)
+
+    walk(as_jaxpr(closed_jaxpr), "", 1)
+    return out
+
+
+# ------------------------------------------------------------------ pairs
+@dataclass
+class FormPair:
+    """One declared-bitwise form pair: two trace thunks + the rewrite
+    classes under which their programs are expected to agree."""
+
+    name: str
+    contract: str                      # the runtime-proven claim
+    form_a: str
+    form_b: str
+    trace_a: Callable[[], Any]         # -> closed_jaxpr (or (closed, ...))
+    trace_b: Callable[[], Any]
+    rewrites: frozenset = frozenset()
+    dim_aliases_a: Dict[int, str] = field(default_factory=dict)
+    dim_aliases_b: Dict[int, str] = field(default_factory=dict)
+    # with the "recompute" rewrite: the largest compute-mass ratio the
+    # decomposed form may pay for overlap (bounded — a missing block of
+    # work still diverges)
+    recompute_bound: float = 16.0
+    note: str = ""
+
+
+@dataclass
+class Divergence:
+    op: str
+    kind: str
+    sig: str
+    count_a: int
+    count_b: int
+    where_a: str                       # provenance (or "<absent>")
+    where_b: str
+    rule: str                          # "R10" for reductions, else "parity"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    def format(self) -> str:
+        return (
+            f"[{self.rule}] {self.op} ({self.kind}) {self.sig}: "
+            f"{self.count_a}x @ {self.where_a} vs "
+            f"{self.count_b}x @ {self.where_b}"
+        )
+
+
+@dataclass
+class ParityCertificate:
+    pair: str
+    contract: str
+    form_a: str
+    form_b: str
+    ok: bool
+    rewrites: Tuple[str, ...]
+    anchors_a: int
+    anchors_b: int
+    matched_buckets: int
+    folded_buckets: int                # repaired by chunking/decomposition
+    divergences: List[Divergence]
+    seconds: float
+
+    @property
+    def first_divergence(self) -> Optional[Divergence]:
+        return self.divergences[0] if self.divergences else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "pair": self.pair,
+            "contract": self.contract,
+            "forms": [self.form_a, self.form_b],
+            "ok": self.ok,
+            "rewrites": list(self.rewrites),
+            "anchors": [self.anchors_a, self.anchors_b],
+            "matched_buckets": self.matched_buckets,
+            "folded_buckets": self.folded_buckets,
+            "divergences": [d.to_dict() for d in self.divergences],
+            "seconds": round(self.seconds, 3),
+        }
+
+    def format(self) -> str:
+        if self.ok:
+            folded = (
+                f", {self.folded_buckets} folded" if self.folded_buckets
+                else ""
+            )
+            return (
+                f"paritycheck: {self.pair}: CERTIFIED "
+                f"[{self.form_a} == {self.form_b} modulo "
+                f"{','.join(self.rewrites) or 'nothing'}] "
+                f"({self.matched_buckets} buckets{folded}, "
+                f"{self.anchors_a}/{self.anchors_b} anchors, "
+                f"{self.seconds:.2f}s)"
+            )
+        lines = [
+            f"paritycheck: {self.pair}: DIVERGENT "
+            f"[{self.form_a} vs {self.form_b}] "
+            f"({len(self.divergences)} divergent bucket(s), "
+            f"{self.seconds:.2f}s)"
+        ]
+        lines.extend("  " + d.format() for d in self.divergences[:8])
+        return "\n".join(lines)
+
+
+def _closed_of(traced):
+    """Trace thunks may return a bare closed_jaxpr or a tuple whose
+    first element is one (trace_serving_step/trace_train_step style)."""
+    if isinstance(traced, tuple):
+        return traced[0]
+    return traced
+
+
+def _bucket(anchors: Sequence[Anchor]):
+    buckets: Dict[Tuple, Dict[str, Any]] = {}
+    for a in anchors:
+        key = (a.kind, a.op, a.sig)
+        b = buckets.setdefault(key, {
+            "count": 0, "mass": 0.0, "path": a.path, "order": a.order,
+        })
+        b["count"] += a.weight
+        b["mass"] += a.mass
+    return buckets
+
+
+def prove_parity(pair: FormPair) -> ParityCertificate:
+    """Trace both forms, normalize, compare anchor multisets modulo the
+    pair's rewrite classes; certify or report the first divergent op."""
+    t0 = time.time()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        closed_a = _closed_of(pair.trace_a())
+        closed_b = _closed_of(pair.trace_b())
+    anch_a = extract_anchors(closed_a, pair.rewrites, pair.dim_aliases_a)
+    anch_b = extract_anchors(closed_b, pair.rewrites, pair.dim_aliases_b)
+    ba, bb = _bucket(anch_a), _bucket(anch_b)
+
+    matched = folded = 0
+    mismatched_a: List[Tuple] = []
+    mismatched_b: List[Tuple] = []
+    for key in sorted(set(ba) | set(bb), key=str):
+        ca = ba.get(key, {}).get("count", 0)
+        cb = bb.get(key, {}).get("count", 0)
+        if ca == cb:
+            matched += 1
+        else:
+            if key in ba:
+                mismatched_a.append(key)
+            if key in bb:
+                mismatched_b.append(key)
+
+    # alias pass: only buckets the strict pass left over get their
+    # form-specific extents mapped to shared symbols (KV_EXT …), so an
+    # extent that coincides with an unrelated model dim can't collide
+    def _canonize(keys, buckets, aliases):
+        can: Dict[Tuple, Dict[str, Any]] = {}
+        for key in keys:
+            kind, op, sig = key
+            ck = (kind, op, alias_sig(sig, aliases))
+            tgt = can.setdefault(ck, {
+                "count": 0, "mass": 0.0,
+                "path": buckets[key]["path"],
+                "order": buckets[key]["order"],
+            })
+            tgt["count"] += buckets[key]["count"]
+            tgt["mass"] += buckets[key]["mass"]
+            tgt["order"] = min(tgt["order"], buckets[key]["order"])
+        return can
+
+    ba2 = _canonize(mismatched_a, ba, pair.dim_aliases_a)
+    bb2 = _canonize(mismatched_b, bb, pair.dim_aliases_b)
+    mismatched: List[Tuple] = []
+    for key in sorted(set(ba2) | set(bb2), key=str):
+        ca = ba2.get(key, {}).get("count", 0)
+        cb = bb2.get(key, {}).get("count", 0)
+        if ca == cb:
+            matched += 1
+        else:
+            mismatched.append(key)
+    ba, bb = ba2, bb2  # divergence reporting reads the canonical view
+
+    # chunking fold: a mismatched (kind, op) family whose per-side MASS
+    # agrees is the same computation split differently — exactly what
+    # chunked sub-matmuls/reductions do
+    remaining: List[Tuple] = []
+    if "chunking" in pair.rewrites and mismatched:
+        fams: Dict[Tuple[str, str], List[Tuple]] = {}
+        for key in mismatched:
+            fams.setdefault((key[0], key[1]), []).append(key)
+        for fam, keys in fams.items():
+            mass_a = sum(ba[k]["mass"] for k in keys if k in ba)
+            mass_b = sum(bb[k]["mass"] for k in keys if k in bb)
+            if mass_a > 0 and abs(mass_a - mass_b) <= 1e-6 * max(
+                mass_a, mass_b
+            ):
+                folded += len(keys)
+            elif (
+                "recompute" in pair.rewrites
+                and fam[0] == "compute"
+                and mass_a > 0 and mass_b > 0
+                and max(mass_a, mass_b) / min(mass_a, mass_b)
+                <= pair.recompute_bound
+            ):
+                # the decomposed form replicates compute to buy overlap
+                # (expert FFNs re-run per dp member under the a2a ride)
+                # — bounded, so a missing block of work still diverges
+                folded += len(keys)
+            else:
+                remaining.extend(keys)
+        mismatched = remaining
+        remaining = []
+
+    # collective-decomposition fold: hop-count differences over the same
+    # axis set are one logical collective (the extract step already
+    # unified spellings; here presence-on-both-sides is enough)
+    if "collective_decomposition" in pair.rewrites and mismatched:
+        for key in mismatched:
+            if key[0] == "collective" and key in ba and key in bb:
+                folded += 1
+            else:
+                remaining.append(key)
+        mismatched = remaining
+        remaining = []
+
+    # implicit-collectives fold: a GSPMD reference form's collectives
+    # are inserted at COMPILE time and invisible to the traced jaxpr
+    # (the planner's documented bias), so an explicit-collective form
+    # legitimately shows wires its twin cannot. Declared per pair; the
+    # reduction/compute anchors still compare.
+    if "implicit_collectives" in pair.rewrites and mismatched:
+        for key in mismatched:
+            if key[0] == "collective" and (key not in ba or key not in bb):
+                folded += 1
+            else:
+                remaining.append(key)
+        mismatched = remaining
+
+    divergences: List[Divergence] = []
+    for key in sorted(
+        mismatched,
+        key=lambda k: min(
+            ba.get(k, {}).get("order", 1 << 30),
+            bb.get(k, {}).get("order", 1 << 30),
+        ),
+    ):
+        kind, op, sig = key
+        rule = "R10" if kind in ("reduce", "collective", "accum") \
+            else "parity"
+        divergences.append(Divergence(
+            op=op, kind=kind, sig=str(sig),
+            count_a=ba.get(key, {}).get("count", 0),
+            count_b=bb.get(key, {}).get("count", 0),
+            where_a=ba.get(key, {}).get("path", "<absent>"),
+            where_b=bb.get(key, {}).get("path", "<absent>"),
+            rule=rule,
+        ))
+    return ParityCertificate(
+        pair=pair.name,
+        contract=pair.contract,
+        form_a=pair.form_a,
+        form_b=pair.form_b,
+        ok=not divergences,
+        rewrites=tuple(sorted(pair.rewrites)),
+        anchors_a=len(anch_a),
+        anchors_b=len(anch_b),
+        matched_buckets=matched,
+        folded_buckets=folded,
+        divergences=divergences,
+        seconds=time.time() - t0,
+    )
+
+
+# ----------------------------------------------------- pair constructors
+def _serving_trace_thunk(cfg_dict, model):
+    def thunk():
+        from ..serving.engine import trace_serving_step
+
+        return trace_serving_step(model, copy.deepcopy(cfg_dict))
+
+    return thunk
+
+
+def _train_trace_thunk(cfg_dict, model):
+    def thunk():
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as comm
+        from .shardlint import trace_train_step
+
+        comm.destroy_process_group()
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, config=copy.deepcopy(cfg_dict),
+            abstract_init=True,
+        )
+        try:
+            return trace_train_step(engine)
+        finally:
+            engine.destroy()
+
+    return thunk
+
+
+def _serving_kv_extents(ds, mcfg) -> Tuple[int, int]:
+    """(contiguous capacity, paged per-slot view extent) for the
+    paged-vs-contiguous dim aliasing."""
+    from ..serving.engine import _align_cache
+
+    srv = ds.serving
+    max_tokens = min(int(srv.max_tokens), mcfg.max_seq_len)
+    capacity = _align_cache(max_tokens + int(srv.token_budget))
+    pages_per_slot = srv.pages_per_slot(max_tokens)
+    return capacity, pages_per_slot * int(srv.page_size)
+
+
+def config_parity_pairs(config, model) -> List[FormPair]:
+    """The form pairs a ds_config declares (ISSUE 15): paged vs
+    contiguous and moe stock vs chunked for serving configs; TP ring vs
+    XLA reference, wire codec vs full-width, and moe_a2a overlapped vs
+    stock for training configs. Each pair's thunks re-trace abstractly —
+    no state, no compile."""
+    from ..config import DeepSpeedConfig
+
+    ds = (
+        config if isinstance(config, DeepSpeedConfig)
+        else DeepSpeedConfig(copy.deepcopy(config))
+    )
+    raw = copy.deepcopy(ds.raw if hasattr(ds, "raw") else config)
+    pairs: List[FormPair] = []
+    mcfg = getattr(model, "config", None)
+
+    if ds.serving.enabled:
+        # fleet routing is host-side (per-replica steps are identical);
+        # the contiguous twin must also shed it — disaggregation
+        # requires the paged arena by validation
+        srv = {
+            k: v for k, v in dict(raw.get("serving") or {}).items()
+            if k != "fleet"
+        }
+        # ---- paged vs contiguous (always constructible) ----------------
+        paged_raw = copy.deepcopy(raw)
+        paged_raw["serving"] = dict(srv, paged=True)
+        contig_raw = copy.deepcopy(raw)
+        contig_raw["serving"] = {
+            k: v for k, v in srv.items()
+            if k not in ("paged", "page_size", "num_pages")
+        }
+        cap, paged_ext = _serving_kv_extents(
+            DeepSpeedConfig(copy.deepcopy(paged_raw)), mcfg
+        )
+        pairs.append(FormPair(
+            name="serving/paged-vs-contiguous",
+            contract=(
+                "the block-paged arena step emits token-for-token the "
+                "contiguous arena step (tests/test_serving_paged.py, "
+                "BITWISE)"
+            ),
+            form_a="paged",
+            form_b="contiguous",
+            trace_a=_serving_trace_thunk(paged_raw, model),
+            trace_b=_serving_trace_thunk(contig_raw, model),
+            rewrites=frozenset({"addressing", "chunking"}),
+            dim_aliases_a={paged_ext: "KV_EXT"},
+            dim_aliases_b={cap: "KV_EXT"},
+            note="per-slot paged views vs the contiguous capacity are "
+                 "the same logical KV extent (KV_EXT)",
+        ))
+        # ---- moe stock vs chunked (when the ring can actually run) -----
+        if mcfg is not None and getattr(mcfg, "is_moe", False):
+            from ..serving.engine import resolve_moe_a2a_form, \
+                serving_ep_size
+            from ..comm.topology import MeshTopology, ParallelDims
+            import jax
+            import jax.numpy as jnp
+
+            ep = serving_ep_size(ds.moe, mcfg)
+            if ep > 1:
+                topo = MeshTopology(
+                    dims=ParallelDims(
+                        tp=max(int(ds.tensor_parallel.tp_size), 1), ep=ep
+                    ),
+                    devices=jax.devices()[
+                        :max(int(ds.tensor_parallel.tp_size), 1) * ep
+                    ],
+                )
+                resolved = resolve_moe_a2a_form(
+                    "chunked", mcfg, topo, int(ds.serving.token_budget),
+                    jnp.dtype(ds.compute_dtype).itemsize,
+                    max_slots=int(ds.serving.max_slots),
+                )
+                if resolved == "chunked":
+                    stock_raw = copy.deepcopy(raw)
+                    stock_raw["serving"] = dict(srv, moe_a2a="stock")
+                    chunk_raw = copy.deepcopy(raw)
+                    chunk_raw["serving"] = dict(srv, moe_a2a="chunked")
+                    pairs.append(FormPair(
+                        name="serving/moe-a2a-stock-vs-chunked",
+                        contract=(
+                            "the chunked-ppermute expert combine ride "
+                            "equals the stock-collectives exchange "
+                            "(tests/test_serving_moe.py, BITWISE)"
+                        ),
+                        form_a="stock",
+                        form_b="chunked",
+                        trace_a=_serving_trace_thunk(stock_raw, model),
+                        trace_b=_serving_trace_thunk(chunk_raw, model),
+                        rewrites=frozenset({
+                            "addressing", "chunking",
+                            "collective_decomposition",
+                            "implicit_collectives",
+                        }),
+                    ))
+        return pairs
+
+    # ---------------- training configs ----------------------------------
+    tp_cfg = ds.tensor_parallel
+    if getattr(tp_cfg, "overlap_comm", False) and \
+            int(tp_cfg.tp_size) > 1:
+        on_raw = copy.deepcopy(raw)
+        off_raw = copy.deepcopy(raw)
+        off_raw.setdefault("tensor_parallel", {})
+        off_raw["tensor_parallel"] = dict(
+            off_raw["tensor_parallel"], overlap_comm=False
+        )
+        pairs.append(FormPair(
+            name="train/tp-ring-vs-xla",
+            contract=(
+                "the decomposed collective-matmul rings equal the "
+                "GSPMD/XLA reference projections "
+                "(tests/test_tp_overlap.py, BITWISE)"
+            ),
+            form_a="ring",
+            form_b="xla",
+            trace_a=_train_trace_thunk(on_raw, model),
+            trace_b=_train_trace_thunk(off_raw, model),
+            rewrites=frozenset({
+                "addressing", "chunking", "collective_decomposition",
+                "implicit_collectives",
+            }),
+        ))
+    # gate on the RESOLVED flag: a dict-valued overlap_a2a section with
+    # enabled=false must not declare a vacuous pair of identical forms
+    _ov = getattr(ds.moe, "overlap_a2a", None)
+    if bool(getattr(_ov, "enabled", _ov)):
+        on_raw = copy.deepcopy(raw)
+        off_raw = copy.deepcopy(raw)
+        off_moe = dict(off_raw.get("moe") or {})
+        ov = off_moe.get("overlap_a2a")
+        if isinstance(ov, dict):
+            off_moe["overlap_a2a"] = dict(ov, enabled=False)
+        else:
+            off_moe["overlap_a2a"] = False
+        off_raw["moe"] = off_moe
+        pairs.append(FormPair(
+            name="train/moe-a2a-stock-vs-chunked",
+            contract=(
+                "the chunked-ppermute expert exchange equals the stock "
+                "GSPMD all-to-alls (tests/test_moe_a2a_overlap.py, "
+                "BITWISE)"
+            ),
+            form_a="chunked",
+            form_b="stock",
+            trace_a=_train_trace_thunk(on_raw, model),
+            trace_b=_train_trace_thunk(off_raw, model),
+            rewrites=frozenset({
+                "addressing", "chunking", "collective_decomposition",
+                "implicit_collectives", "recompute",
+            }),
+            note="the chunked ride recomputes expert FFNs per dp member "
+                 "to hide the exchange — compute mass is traded for "
+                 "wire (docs/overlap.md), bounded by recompute_bound",
+        ))
+    zero = raw.get("zero_optimization") or {}
+    wired = [
+        k for k in ("grad_wire", "param_wire")
+        if str(zero.get(k, "fp32")).lower() not in ("fp32", "off", "none",
+                                                    "false")
+    ] or (["grad_wire"] if zero.get("zero_quantized_gradients") else []) \
+        + (["param_wire"] if zero.get("zero_quantized_weights") else [])
+    if wired:
+        codec_raw = copy.deepcopy(raw)
+        full_raw = copy.deepcopy(raw)
+        fz = dict(full_raw.get("zero_optimization") or {})
+        for k in ("grad_wire", "param_wire"):
+            fz[k] = "fp32"
+        fz.pop("zero_quantized_gradients", None)
+        fz.pop("zero_quantized_weights", None)
+        full_raw["zero_optimization"] = fz
+        pairs.append(FormPair(
+            name="train/wire-codec-vs-full-width",
+            contract=(
+                "the int8/int4 wire collectives carry the same "
+                "reduction structure as the fp32 full-width baseline "
+                "(tests/test_wires.py; error within the codec's "
+                "property-tested bound)"
+            ),
+            form_a="codec",
+            form_b="fp32",
+            trace_a=_train_trace_thunk(codec_raw, model),
+            trace_b=_train_trace_thunk(full_raw, model),
+            rewrites=frozenset({
+                "addressing", "chunking", "collective_decomposition",
+                "implicit_collectives", "codec",
+            }),
+        ))
+    return pairs

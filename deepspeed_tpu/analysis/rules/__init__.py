@@ -67,3 +67,6 @@ from . import precision  # noqa: E402,F401  (R5)
 from . import capacity  # noqa: E402,F401  (R6)
 from . import reshard  # noqa: E402,F401  (R7)
 from . import overlap_budget  # noqa: E402,F401  (R8)
+from . import rng  # noqa: E402,F401  (R9)
+from . import reduction_order  # noqa: E402,F401  (R10)
+from . import trace_stability  # noqa: E402,F401  (R11)

@@ -1,0 +1,342 @@
+"""R10 — reduction-order.
+
+Float addition is not associative: every declared-bitwise pair in this
+repo (paged == contiguous, ring == XLA reference, wire == full-width)
+implicitly asserts that the two forms GROUP their accumulations the same
+way. R10 has two halves:
+
+Single-program (this registry rule): the dequant-accumulate dtype
+contract. A wire codec (int8/int4 — comm/wires.py) is only sound when
+the decoded blocks ACCUMULATE IN F32 ("dequant-accumulate in f32" — the
+qgZ law, the R5 master-path contract's accumulator-side twin). The
+analysis runs a three-level taint over the program:
+
+    0 clean · 1 decoded block (a convert from a sub-8-bit integer
+    payload to float, still inside its scale-application/layout
+    neighbourhood) · 2 accumulated blocks (an add of two level-≥1
+    values — a partial-block sum)
+
+and flags accumulation evidence executed below 32-bit float:
+
+- a CHAINED accumulation — an ``add``/``sub`` in bf16/f16 folding a
+  decoded block into an already-accumulated value (the hand-rolled
+  wire-ring ``acc += deq(chunk)`` shape);
+- a scan/while CARRY produced by a sub-f32 add of decoded blocks
+  (cross-iteration accumulation in narrow float);
+- ``reduce_sum``/``cumsum`` over decoded blocks with a sub-f32 result
+  (jnp.sum auto-upcasts its accumulator — lax-level code does not);
+- a cross-member ``psum`` of decoded blocks in sub-f32 (psum never
+  upcasts).
+
+Deliberately NOT flagged: a dot_general over dequantized weights
+(compute, not wire accumulation — MXU accumulation is f32 and out of
+jaxpr sight), a single add of two *different* decoded tensors
+(``wte[ids] + wpe[pos]`` under an int8 ``param_wire`` is forward
+policy), and anything after an upcast-and-sum in f32 — that IS the
+contract, and downstream bf16 math is fine. A lone two-member
+accumulate (one add) is below the chain threshold and relies on the
+psum/reduce/carry checks instead.
+
+Cross-form (the differential half): "grouping changes across the two
+forms of a declared-bitwise pair" — psum vs reduce-scatter
+reassociation, a scatter-add into shared destinations appearing on one
+side only, chunked partial sums whose chunking is not a declared
+rewrite. That evidence needs BOTH jaxprs, so it lives in
+``analysis/parity.py``: ``prove_parity`` emits findings labeled R10
+when the divergent anchor is a reduction/collective (docs/shardlint.md
+"parity certificates").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from ..base import ERROR, Finding, LintContext
+from ..trace import ClosedJaxpr, Jaxpr, Literal, as_jaxpr, collective_axes, \
+    scan_split
+from . import register_rule
+
+# payload dtypes whose decode marks a value as a level-1 wire block
+_WIRE_INTS = ("int8", "uint8", "int4", "uint4")
+# ops that keep a decoded block's level unconditionally (layout,
+# masking, float casts) — anything unlisted clears to level 0
+_FLOW = {
+    "neg", "select_n", "copy",
+    "device_put", "reshape", "transpose", "squeeze", "expand_dims",
+    "broadcast_in_dim", "slice", "dynamic_slice", "concatenate", "pad",
+    "rev", "gather", "dynamic_update_slice",
+}
+# scale application: mul/div (and clamping) keep the LARGER operand's
+# level when the other is a broadcast scale (strictly fewer elements).
+# An equal-size product — e.g. a backward cotangent times the decoded
+# forward value — is new data, not a decoded block, and clears: bf16
+# psums of ordinary gradients must stay R10-silent.
+_SCALED = {"mul", "div", "max", "min", "clamp"}
+_REDUCING = {"reduce_sum", "cumsum"}
+_CROSS_MEMBER = {"psum"}
+_CALL_LIKE_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def _is_sub_f32_float(dtype) -> bool:
+    return (
+        dtype is not None
+        and jnp.issubdtype(dtype, jnp.floating)
+        and jnp.finfo(dtype).bits < 32
+    )
+
+
+def _is_wire_int(dtype) -> bool:
+    return dtype is not None and str(dtype) in _WIRE_INTS
+
+
+def _out_dtype(eqn):
+    if not eqn.outvars:
+        return None
+    return getattr(getattr(eqn.outvars[0], "aval", None), "dtype", None)
+
+
+class _Walk:
+    """Recursive taint walk with the 0/1/2 lattice. Control flow mirrors
+    analysis.trace.DataflowAnalysis; carries iterate to a small
+    fixpoint so cross-iteration accumulators reach level 2."""
+
+    MAX_ITERS = 4
+
+    def __init__(self, emit):
+        self.emit = emit
+        self._reported = set()
+
+    def _flag(self, path: str, name: str, message: str) -> None:
+        key = (path, name)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.emit(Finding(
+            rule="R10",
+            severity=ERROR,
+            message=(
+                f"{message} — the dequant-accumulate contract "
+                "(comm/wires.py: decode to f32 BEFORE any sum) is "
+                "violated; the accumulated error depends on grouping and "
+                "the declared-bitwise pair cannot hold"
+            ),
+            where=f"{path}/{name}",
+        ))
+
+    def run(self, jaxpr: Jaxpr, in_levels: List[int], path: str = ""
+            ) -> List[int]:
+        env: Dict[int, int] = {}
+
+        def read(a) -> int:
+            if isinstance(a, Literal):
+                return 0
+            return env.get(id(a), 0)
+
+        for var, lv in zip(jaxpr.invars, in_levels):
+            env[id(var)] = int(lv)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ivals = [read(a) for a in eqn.invars]
+            outs = self._eqn(eqn, name, ivals, path)
+            for v, lv in zip(eqn.outvars, outs):
+                env[id(v)] = int(lv)
+        return [read(v) for v in jaxpr.outvars]
+
+    # ------------------------------------------------------------------
+    def _eqn(self, eqn, name, ivals, path) -> List[int]:
+        n_out = len(eqn.outvars)
+        dtype = _out_dtype(eqn)
+        if name == "convert_element_type":
+            in_dtype = getattr(
+                getattr(eqn.invars[0], "aval", None), "dtype", None
+            )
+            if _is_wire_int(in_dtype) and dtype is not None and \
+                    jnp.issubdtype(dtype, jnp.floating):
+                return [1] * n_out  # the decode itself
+            if dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+                return [max(ivals or [0])] * n_out
+            return [0] * n_out
+        if name in ("add", "sub"):
+            a, b = (ivals + [0, 0])[:2]
+            if a >= 1 and b >= 1:
+                if not _is_sub_f32_float(dtype):
+                    # accumulated in >= f32: the contract is satisfied
+                    # and the result is ordinary data from here on
+                    return [0] * n_out
+                if max(a, b) >= 2:
+                    self._flag(path, name, (
+                        "chained accumulation of wire-decoded blocks in "
+                        f"{dtype} (acc += dequantized chunk)"
+                    ))
+                return [2] * n_out
+            return [0] * n_out
+        if name in _REDUCING:
+            if max(ivals or [0]) >= 1 and _is_sub_f32_float(dtype):
+                self._flag(path, name, (
+                    f"{name} over wire-decoded blocks in {dtype}"
+                ))
+            return [0] * n_out
+        if name in _CROSS_MEMBER:
+            if max(ivals or [0]) >= 1 and _is_sub_f32_float(dtype):
+                axes = ",".join(collective_axes(eqn)) or "?"
+                self._flag(path, name, (
+                    f"cross-member {name} over axis ({axes}) of "
+                    f"wire-decoded blocks in {dtype}"
+                ))
+            return [0] * n_out
+        if name in _FLOW:
+            return [max(ivals or [0])] * n_out
+        if name in _SCALED:
+            sizes = [
+                getattr(getattr(a, "aval", None), "size", 0)
+                for a in eqn.invars
+            ]
+            if sizes:
+                big = max(sizes)
+                winners = [
+                    lv for lv, sz in zip(ivals, sizes) if sz == big
+                ]
+                if len(winners) == 1 or name == "clamp":
+                    return [max(winners)] * n_out
+            return [0] * n_out
+        # control flow ------------------------------------------------------
+        if name == "scan":
+            body = as_jaxpr(eqn.params["jaxpr"])
+            nc, ncar = scan_split(eqn)
+            consts = ivals[:nc]
+            carry = ivals[nc:nc + ncar]
+            xs = ivals[nc + ncar:]
+            outs = [0] * len(body.outvars)
+            for _ in range(self.MAX_ITERS):
+                outs = self.run(body, consts + carry + xs, f"{path}/scan")
+                new_carry = [max(c, o) for c, o in zip(carry, outs[:ncar])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            self._carry_check(body, consts, ncar, xs, f"{path}/scan")
+            return carry + outs[ncar:]
+        if name == "while":
+            body = as_jaxpr(eqn.params["body_jaxpr"])
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            bconsts = ivals[cn:cn + bn]
+            carry = ivals[cn + bn:]
+            for _ in range(self.MAX_ITERS):
+                outs = self.run(body, bconsts + carry, f"{path}/while")
+                new_carry = [max(c, o) for c, o in zip(carry, outs)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            self._carry_check(body, bconsts, len(carry), (),
+                              f"{path}/while")
+            return carry
+        if name == "cond":
+            branches = eqn.params["branches"]
+            operands = ivals[1:]
+            outs = None
+            for br in branches:
+                o = self.run(as_jaxpr(br), list(operands), f"{path}/cond")
+                outs = o if outs is None else [max(a, b)
+                                               for a, b in zip(outs, o)]
+            return outs if outs is not None else []
+        if name == "shard_map":
+            return self.run(as_jaxpr(eqn.params["jaxpr"]), ivals,
+                            f"{path}/shard_map")
+        for key in _CALL_LIKE_KEYS:
+            if key in eqn.params and isinstance(
+                eqn.params[key], (Jaxpr, ClosedJaxpr)
+            ):
+                body = as_jaxpr(eqn.params[key])
+                sub = f"{path}/{name}"
+                if len(body.invars) == len(ivals):
+                    return self.run(body, ivals, sub)
+                if len(body.invars) < len(ivals):
+                    return self.run(body, ivals[-len(body.invars):], sub)
+                break
+        return [0] * n_out
+
+    def _carry_check(self, body, consts, ncar, xs, path) -> None:
+        """A loop carry fed by a sub-f32 add of decoded blocks:
+        cross-iteration accumulation in narrow float (``carry += deq``).
+        Carries are seeded at level 2 — *assume* the carry is an
+        accumulator — and the flag fires only when the carry-producing
+        equation is an add folding a level-≥1 block into it, so ordinary
+        bf16 carries (residual streams, KV arenas) stay silent."""
+        rec = _Recorder()
+        rec.run(body, list(consts) + [2] * ncar + list(xs), path)
+        producers = {}
+        for eqn in body.eqns:
+            for v in eqn.outvars:
+                producers[id(v)] = eqn
+        for ov in body.outvars[:ncar]:
+            # hop back through pure-flow ops to the producing accumulate
+            cur = ov
+            eqn = producers.get(id(cur))
+            for _ in range(8):
+                if eqn is None or eqn.primitive.name not in _FLOW:
+                    break
+                nxt = max(
+                    (a for a in eqn.invars if not isinstance(a, Literal)),
+                    key=lambda a: rec.levels.get(id(a), 0),
+                    default=None,
+                )
+                if nxt is None:
+                    eqn = None
+                    break
+                cur = nxt
+                eqn = producers.get(id(cur))
+            if eqn is None or eqn.primitive.name not in ("add", "sub"):
+                continue
+            dtype = _out_dtype(eqn)
+            if not _is_sub_f32_float(dtype):
+                continue
+            lv = [
+                0 if isinstance(a, Literal) else rec.levels.get(id(a), 0)
+                for a in eqn.invars
+            ]
+            if len(lv) >= 2 and max(lv[:2]) >= 2 and min(lv[:2]) >= 1:
+                self._flag(path, eqn.primitive.name, (
+                    "loop-carried accumulator folds wire-decoded blocks "
+                    f"in {dtype}"
+                ))
+
+
+class _Recorder(_Walk):
+    """Level recorder for the carry check: same walk, emission muted,
+    per-var levels kept for operand inspection."""
+
+    def __init__(self):
+        super().__init__(lambda f: None)
+        self.levels: Dict[int, int] = {}
+
+    def run(self, jaxpr, in_levels, path=""):
+        env: Dict[int, int] = {}
+
+        def read(a):
+            if isinstance(a, Literal):
+                return 0
+            return env.get(id(a), 0)
+
+        for var, lv in zip(jaxpr.invars, in_levels):
+            env[id(var)] = int(lv)
+            self.levels[id(var)] = max(
+                self.levels.get(id(var), 0), int(lv)
+            )
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ivals = [read(a) for a in eqn.invars]
+            outs = self._eqn(eqn, name, ivals, path)
+            for v, lv in zip(eqn.outvars, outs):
+                env[id(v)] = int(lv)
+                self.levels[id(v)] = max(self.levels.get(id(v), 0), int(lv))
+        return [read(v) for v in jaxpr.outvars]
+
+
+@register_rule("R10", "reduction-order")
+def reduction_order(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    jaxpr = ctx.jaxpr
+    _Walk(findings.append).run(jaxpr, [0] * len(jaxpr.invars), "")
+    return findings

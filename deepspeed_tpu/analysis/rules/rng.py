@@ -1,0 +1,373 @@
+"""R9 — rng-discipline.
+
+Every bitwise-parity claim in this repo (spec-on == spec-off, serving ==
+generate, ep-sharded == dense) rides on ONE rule about randomness: a PRNG
+key is consumed exactly once, and the chain advances only where the
+reference path advances it. The statically-visible violations:
+
+(a) key reuse — the same key value is consumed by two sampling/split
+    sites (``random_bits`` / ``random_split`` / ``random_fold_in``).
+    Two draws from one key are correlated (identical, for equal shapes),
+    and the replay chain desynchronizes from the reference the moment
+    one path splits where the other samples.
+
+(b) loop-invariant key — a key that enters a scan/while body as a
+    loop-invariant (const) and is consumed inside the body: every
+    iteration replays the SAME stream instead of chaining
+    (split-per-iteration is the discipline; xs-sliced key arrays are
+    fine — each iteration gets its own).
+
+(c) trace-time seeding — ``random_seed`` from a literal inside the
+    step: a host RNG read (or a bare ``PRNGKey(0)``) baked at trace
+    time, so every invocation of the compiled step replays one stream.
+    Keys must be threaded through the step's inputs.
+
+(d) claimed-keyfree path — when the driver arms
+    ``ctx.claims_keyfree`` (an eval/serving program that claims
+    key-free bitwiseness — the PR-14 gating contract: gating at eval is
+    bitwise with/without a key and never splits), ANY key-consuming
+    site is a finding.
+
+The analysis is a value-numbering walk: each key value gets an identity
+rooted at its origin (invar / seed eqn) and refined by the derivation
+chain (split → slice picks distinct subkeys; data-dependent selection
+gets a fresh identity — conservative, never a false reuse). Consumption
+sites under sibling ``cond`` branches are exclusive and never pair up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from ..base import ERROR, Finding, LintContext
+from ..trace import ClosedJaxpr, Jaxpr, Literal, as_jaxpr, scan_split
+from . import register_rule
+
+# primitives that CONSUME a key (advance/occupy its stream);
+# random_fold_in is a DERIVATION, not a consumption — folding distinct
+# data out of one key is the documented discipline (fold_in(key, step))
+_CONSUMING = ("random_bits", "random_split")
+# primitives through which a key keeps its identity
+_IDENTITY = {
+    "random_wrap", "random_unwrap", "copy", "squeeze", "expand_dims",
+    "reshape", "broadcast_in_dim", "convert_element_type", "device_put",
+    "transpose",
+}
+_CALL_LIKE_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def _is_key_like(aval) -> bool:
+    """True for typed PRNG keys and raw uint32 key buffers."""
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        if jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key):
+            return True
+    except (AttributeError, TypeError):
+        pass
+    return False
+
+
+class _Site:
+    """One key-consuming equation occurrence."""
+
+    __slots__ = ("path", "prim", "pos")
+
+    def __init__(self, path: str, prim: str, pos: int):
+        self.path = path
+        self.prim = prim
+        self.pos = pos
+
+    def where(self) -> str:
+        return f"{self.path}/{self.prim}" if self.path else self.prim
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.path, self.prim, self.pos)
+
+
+def _exclusive(a: _Site, b: _Site) -> bool:
+    """Sites under sibling branches of the SAME cond equation never both
+    execute (path segments ``cond[<eqn>]#<branch>`` — the eqn index
+    keeps two different conds from reading as siblings)."""
+    pa, pb = a.path.split("/"), b.path.split("/")
+    for x, y in zip(pa, pb):
+        if x != y:
+            return (
+                "#" in x and "#" in y
+                and x.startswith("cond[")
+                and x.split("#")[0] == y.split("#")[0]
+            )
+    return False
+
+
+class _KeyWalk:
+    """Value-numbering walk over key dataflow. ``keyid`` is a hashable
+    identity; ``loop_keys`` marks identities that are loop-invariant in
+    the jaxpr currently being walked."""
+
+    def __init__(self):
+        self._fresh = 0
+        # keyid -> [ _Site ]  (consumption registry)
+        self.consumed: Dict[Any, List[_Site]] = {}
+        # keyid -> site of the loop-invariant consumption finding
+        self.loop_hits: List[Tuple[Any, _Site]] = []
+        self.seed_sites: List[_Site] = []
+
+    def fresh(self) -> Tuple[str, int]:
+        self._fresh += 1
+        return ("fresh", self._fresh)
+
+    @staticmethod
+    def _invariant(keyid, loop_keys) -> bool:
+        """True when the key value is the SAME on every loop iteration:
+        a loop-invariant root, or a derivation of one whose every step
+        is deterministic (split/slice at a fixed site; fold over literal
+        data). A fold over traced data derives a fresh stream per value
+        and is the legitimate in-loop pattern."""
+        if keyid is None:
+            return False
+        if keyid in loop_keys:
+            return True
+        if not isinstance(keyid, tuple):
+            return False
+        tag = keyid[0]
+        if tag in ("split", "slice"):
+            return _KeyWalk._invariant(keyid[1], loop_keys)
+        if tag == "fold":
+            return keyid[2][0] == "lit" and _KeyWalk._invariant(
+                keyid[1], loop_keys
+            )
+        return False
+
+    def _consume(self, keyid, site: _Site, loop_keys) -> None:
+        if keyid is None:
+            return
+        self.consumed.setdefault(keyid, []).append(site)
+        if self._invariant(keyid, loop_keys):
+            self.loop_hits.append((keyid, site))
+
+    def run(self, jaxpr: Jaxpr, in_ids: List[Any], path: str = "",
+            loop_keys=frozenset()) -> List[Any]:
+        env: Dict[Any, Any] = {}
+
+        def read(a):
+            if isinstance(a, Literal):
+                return None
+            kid = env.get(a)
+            if kid is None:
+                # identity roots at the VALUE, minted lazily: the same
+                # var consumed through two different sub-programs (two
+                # cond equations, a branch wrap each) must resolve to
+                # ONE key identity, not one per wrap site
+                kid = ("rootvar", id(a))
+                env[a] = kid
+            return kid
+
+        for var, kid in zip(jaxpr.invars, in_ids):
+            if kid is not None:
+                env[var] = kid
+        for pos, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            ivals = [read(a) for a in eqn.invars]
+            outs = self._eqn(eqn, name, ivals, path, pos, loop_keys)
+            for v, kid in zip(eqn.outvars, outs):
+                if kid is not None:
+                    env[v] = kid
+        return [read(v) for v in jaxpr.outvars]
+
+    # ------------------------------------------------------------------
+    def _eqn(self, eqn, name, ivals, path, pos, loop_keys):
+        n_out = len(eqn.outvars)
+        if name == "random_seed":
+            if all(isinstance(a, Literal) for a in eqn.invars):
+                self.seed_sites.append(_Site(path, name, pos))
+            return [self.fresh()] * n_out
+        if name in _CONSUMING:
+            self._consume(ivals[0], _Site(path, name, pos), loop_keys)
+            if name == "random_split":
+                return [("split", ivals[0] or self.fresh(), path, pos)] * n_out
+            return [None] * n_out  # bits: output is data, not a key
+        if name == "random_fold_in":
+            parent = ivals[0] or self.fresh()
+            data_static = all(
+                isinstance(a, Literal) for a in eqn.invars[1:]
+            )
+            mark = ("lit",) if data_static else ("dyn", path, pos)
+            return [("fold", parent, mark)] * n_out
+        if name == "random_wrap":
+            # raw uint32 key words acquire identity here: two wraps of
+            # the same buffer are the same key
+            src = ivals[0]
+            if src is None and not isinstance(eqn.invars[0], Literal):
+                src = ("rootvar", id(eqn.invars[0]))
+            return [src] * n_out
+        if name in _IDENTITY:
+            src = next((v for v in ivals if v is not None), None)
+            return [src] * n_out
+        if name == "slice" and ivals[0] is not None:
+            params = (
+                tuple(eqn.params.get("start_indices") or ()),
+                tuple(eqn.params.get("limit_indices") or ()),
+            )
+            return [("slice", ivals[0], params)] * n_out
+        # control flow -----------------------------------------------------
+        if name == "scan":
+            body = as_jaxpr(eqn.params["jaxpr"])
+            nc, ncar = scan_split(eqn)
+            length = eqn.params.get("length")
+            looping = length is None or length > 1
+            # consts keep (or mint) identity and become loop-invariant;
+            # carries and xs get fresh per-iteration identities
+            # (chained / per-iteration slices)
+            consts = [
+                c if c is not None else ("rootvar", id(v))
+                for c, v in zip(ivals[:nc], eqn.invars[:nc])
+            ]
+            carries = ivals[nc:nc + ncar]
+            body_in = (
+                consts
+                + [self.fresh() if c is not None else None for c in carries]
+                + [self.fresh() if x is not None else None
+                   for x in ivals[nc + ncar:]]
+            )
+            inner_loop = (
+                loop_keys | set(consts) if looping else loop_keys
+            )
+            outs = self.run(body, body_in, f"{path}/scan[{pos}]", inner_loop)
+            return outs[:ncar] + [None] * (n_out - ncar)
+        if name == "while":
+            body = as_jaxpr(eqn.params["body_jaxpr"])
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            bconsts = [
+                c if c is not None else ("rootvar", id(v))
+                for c, v in zip(ivals[cn:cn + bn], eqn.invars[cn:cn + bn])
+            ]
+            carries = ivals[cn + bn:]
+            body_in = list(bconsts) + [
+                self.fresh() if c is not None else None for c in carries
+            ]
+            inner_loop = loop_keys | set(bconsts)
+            self.run(body, body_in, f"{path}/while[{pos}]", inner_loop)
+            return [None] * n_out
+        if name == "cond":
+            branches = eqn.params["branches"]
+            operands = ivals[1:]
+            outs = [None] * n_out
+            for i, br in enumerate(branches):
+                o = self.run(as_jaxpr(br), list(operands),
+                             f"{path}/cond[{pos}]#{i}", loop_keys)
+                outs = [a if a is not None else b for a, b in zip(outs, o)]
+            return outs
+        if name == "shard_map":
+            return self.run(as_jaxpr(eqn.params["jaxpr"]), ivals,
+                            f"{path}/shard_map[{pos}]", loop_keys)
+        for key in _CALL_LIKE_KEYS:
+            if key in eqn.params and isinstance(
+                eqn.params[key], (Jaxpr, ClosedJaxpr)
+            ):
+                body = as_jaxpr(eqn.params[key])
+                sub = f"{path}/{name}[{pos}]"
+                if len(body.invars) == len(ivals):
+                    return self.run(body, ivals, sub, loop_keys)
+                if len(body.invars) < len(ivals):
+                    return self.run(body, ivals[-len(body.invars):], sub,
+                                    loop_keys)
+                break
+        # any other op (gather, dynamic_slice with traced start, math on
+        # raw key words): data-dependent derivation — fresh identity per
+        # output, conservatively never a reuse
+        if any(v is not None for v in ivals):
+            return [self.fresh()] * n_out
+        return [None] * n_out
+
+
+@register_rule("R9", "rng-discipline")
+def rng_discipline(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    jaxpr = ctx.jaxpr
+    walk = _KeyWalk()
+    seeds = [
+        ("invar", i) if _is_key_like(getattr(v, "aval", None)) else None
+        for i, v in enumerate(jaxpr.invars)
+    ]
+    # raw uint32 keys only acquire identity at random_wrap; typed-key
+    # invars seed directly
+    walk.run(jaxpr, seeds, "")
+
+    # (a) reuse: one key identity, two non-exclusive consumption sites
+    for keyid, sites in walk.consumed.items():
+        uniq: List[_Site] = []
+        seen = set()
+        for s in sites:
+            if s.key() not in seen:
+                seen.add(s.key())
+                uniq.append(s)
+        live = [
+            s for i, s in enumerate(uniq)
+            if not all(_exclusive(s, t) for t in uniq[:i] + uniq[i + 1:])
+        ] if len(uniq) > 1 else []
+        if len(live) > 1:
+            findings.append(Finding(
+                rule="R9",
+                severity=ERROR,
+                message=(
+                    "PRNG key consumed by "
+                    f"{len(live)} sampling/split sites "
+                    f"({', '.join(s.where() for s in live[:4])}) — draws "
+                    "from one key are correlated and the replay chain "
+                    "desynchronizes from the reference; split first, "
+                    "consume each subkey once"
+                ),
+                where=live[0].where(),
+            ))
+    # (b) loop-invariant consumption
+    reported = set()
+    for keyid, site in walk.loop_hits:
+        if site.key() in reported:
+            continue
+        reported.add(site.key())
+        findings.append(Finding(
+            rule="R9",
+            severity=ERROR,
+            message=(
+                "loop-invariant PRNG key consumed inside a loop body — "
+                "every iteration replays the same stream; chain the key "
+                "through the carry (split per iteration) or feed an xs "
+                "key array"
+            ),
+            where=site.where(),
+        ))
+    # (c) trace-time seeding
+    for site in walk.seed_sites:
+        findings.append(Finding(
+            rule="R9",
+            severity=ERROR,
+            message=(
+                "PRNG key seeded from a trace-time constant inside the "
+                "traced step (a host RNG read or bare PRNGKey(n) baked "
+                "at trace time) — every invocation of the compiled step "
+                "replays one stream; thread keys through the step inputs"
+            ),
+            where=site.where(),
+        ))
+    # (d) claimed-keyfree path
+    if ctx.claims_keyfree:
+        sites = [s for ss in walk.consumed.values() for s in ss]
+        sites += walk.seed_sites
+        for site in sorted({s.key() for s in sites}):
+            findings.append(Finding(
+                rule="R9",
+                severity=ERROR,
+                message=(
+                    "key-consuming site on a path that claims key-free "
+                    "bitwiseness (the eval/serving gating contract: "
+                    "bitwise with or without a key, never splits) — the "
+                    "claim is statically false"
+                ),
+                where=f"{site[0]}/{site[1]}" if site[0] else site[1],
+            ))
+    return findings

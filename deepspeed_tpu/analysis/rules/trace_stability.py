@@ -1,0 +1,92 @@
+"""R11 — trace-stability.
+
+The serving tier's whole performance story is ``step_traces == 1``: one
+compiled program serves every arrival/occupancy/divergence mix. The
+runtime proves it with a retrace counter AFTER hours of replay; R11
+certifies the same contract statically, from the traced step alone.
+
+The step closure's inputs partition into TRACED (jaxpr invars — their
+values flow through the compiled program) and STATIC (python values
+baked into the trace — a new value means a new trace). Per-request /
+per-tick host state — slot occupancy (``num_new``), write frontiers
+(``start_pos``), ``spec_len``, ``cow_src``, page tables, per-slot keys
+— MUST be traced: baking any of them specializes the program on one
+tick's scheduler state and every subsequent tick recompiles.
+
+Evidence comes from the trace driver (``required_traced`` +
+``traced_manifest`` on the LintContext — the drivers in
+analysis/shardlint.py and serving.trace_serving_step know the step's
+argument contract; the rule is silent without it, like R6 without a
+budget). Two failure shapes per required name:
+
+(a) BAKED — the name has no traced invars at all: its value was
+    captured as a python constant / closure literal, so it is static
+    and per-tick values force retraces (``step_traces`` grows without
+    bound).
+
+(b) DEAD — the name is traced but none of its invars feed any
+    equation: the program no longer depends on the input, which means
+    the host value was consulted at trace time instead (the
+    traced-but-baked hybrid: no retrace, but every tick after the first
+    runs with the FIRST tick's value).
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..base import ERROR, Finding, LintContext
+from . import register_rule
+
+
+def _used_invars(jaxpr) -> Set[int]:
+    """Indices of top-level invars that feed at least one equation. An
+    invar that only ECHOES into the outputs does not count: a
+    passed-through per-tick input is exactly the traced-but-baked
+    hybrid shape (b) below — the compute never reads it."""
+    used = set()
+    for eqn in jaxpr.eqns:
+        for a in eqn.invars:
+            used.add(id(a))
+    return {i for i, v in enumerate(jaxpr.invars) if id(v) in used}
+
+
+@register_rule("R11", "trace-stability")
+def trace_stability(ctx: LintContext) -> List[Finding]:
+    if not ctx.required_traced:
+        return []
+    findings: List[Finding] = []
+    jaxpr = ctx.jaxpr
+    manifest = ctx.traced_manifest or ctx.invar_groups
+    live = _used_invars(jaxpr)
+    for name in ctx.required_traced:
+        rng = manifest.get(name)
+        if rng is None or rng[0] >= rng[1]:
+            findings.append(Finding(
+                rule="R11",
+                severity=ERROR,
+                message=(
+                    f"per-tick input {name!r} is STATIC — it was baked "
+                    "into the trace as a python value, so the compiled "
+                    "step is specialized on one tick's host state and "
+                    "every new value retraces (step_traces grows without "
+                    "bound); trace it as a step input instead"
+                ),
+                where="<jit boundary>",
+            ))
+            continue
+        lo, hi = int(rng[0]), int(rng[1])
+        if not any(i in live for i in range(lo, hi)):
+            findings.append(Finding(
+                rule="R11",
+                severity=ERROR,
+                message=(
+                    f"per-tick input {name!r} is traced but DEAD — no "
+                    "equation consumes it, so the program was specialized "
+                    "on the trace-time host value and every later tick "
+                    "silently runs with the first tick's state; make the "
+                    "computation read the traced input"
+                ),
+                where="<jit boundary>",
+            ))
+    return findings

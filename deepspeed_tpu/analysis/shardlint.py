@@ -8,6 +8,11 @@ Three entry points, all CPU-cheap (abstract evaluation only):
   ShapeDtypeStructs) and lint it, plus engine-level closure/donation
   audits the jaxpr alone cannot express.
 - :func:`lint_config` — ds_config (+ model) → abstract engine → lint.
+
+The registry is R1–R11 (docs/shardlint.md); R9 (rng-discipline) and R10
+(reduction-order) run on every program, R11 (trace-stability) arms when
+the trace driver supplies the step's traced-argument manifest — both
+entry points here do.
 """
 
 from __future__ import annotations
@@ -36,6 +41,9 @@ def lint_jaxpr(
     hardware=None,
     donated_invars: Sequence[int] = (),
     invar_groups: Optional[Dict[str, Any]] = None,
+    claims_keyfree: bool = False,
+    required_traced: Sequence[str] = (),
+    traced_manifest: Optional[Dict[str, Any]] = None,
 ) -> List[Finding]:
     """Run the rule registry over one traced program."""
     ctx = LintContext(
@@ -49,6 +57,9 @@ def lint_jaxpr(
         hardware=hardware,
         donated_invars=tuple(donated_invars),
         invar_groups=dict(invar_groups or {}),
+        claims_keyfree=claims_keyfree,
+        required_traced=tuple(required_traced),
+        traced_manifest=dict(traced_manifest or {}),
     )
     return run_rules(ctx, only=only)
 
@@ -295,6 +306,11 @@ def lint_engine(engine, only: Optional[Sequence[str]] = None,
         hardware=hardware,
         donated_invars=meta["donated_invars"],
         invar_groups=meta["invar_groups"],
+        # R11: the train step must consume its per-step batch — a dead
+        # batch input means the program was specialized on trace-time
+        # data (the manifest IS the invar-group split)
+        required_traced=("batch",) if meta["invar_groups"] else (),
+        traced_manifest=meta["invar_groups"],
     )
     findings = run_rules(ctx, only=only)
     for f in _engine_level_findings(engine, out_shape):
@@ -317,7 +333,8 @@ def lint_serving_config(config, model=None, topology=None,
     """Lint a SERVING config: trace the continuous-batching engine's one
     jitted slot step abstractly (serving.trace_serving_step — params and
     the KV arena are ShapeDtypeStructs with real shardings) and run the
-    same R1–R8 registry over it. The declared analytic streams (the
+    same R1–R11 registry over it (R11 armed by the
+    trace's traced-args manifest). The declared analytic streams (the
     per-step KV-arena traffic) feed the planner and rule R8 exactly like
     the training engines' streams."""
     from ..config import DeepSpeedConfig
@@ -351,7 +368,7 @@ def lint_serving_config(config, model=None, topology=None,
     t0 = time.time()
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        closed, arg_shardings, streams = trace_serving_step(
+        closed, arg_shardings, streams, meta = trace_serving_step(
             model, ds, topology
         )
     ctx = LintContext(
@@ -362,6 +379,8 @@ def lint_serving_config(config, model=None, topology=None,
         hbm_budget_bytes=hbm_budget_bytes,
         streams=streams,
         hardware=hardware,
+        required_traced=meta.get("required_traced", ()),
+        traced_manifest=meta.get("traced_manifest", {}),
     )
     findings = run_rules(ctx, only=only)
     report.extend(findings)

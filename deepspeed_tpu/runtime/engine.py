@@ -1037,6 +1037,17 @@ class TpuEngine:
             }
         return streams
 
+    def parity_pairs(self):
+        """The declared-bitwise form pairs of this engine's train step
+        (analysis/parity.py — TP ring vs XLA reference when
+        overlap_comm serves, moe_a2a chunked vs stock, wire codec vs
+        full-width). Each pair re-traces the step abstractly from a
+        knob-flipped twin of this config; ``tools/paritycheck.py``
+        proves them all statically."""
+        from ..analysis.parity import config_parity_pairs
+
+        return config_parity_pairs(self.config.raw, self.model)
+
     def _record_offload_stream(self, steps: int = 1, batch=None):
         if self.comm_logger is None:
             return

@@ -993,15 +993,44 @@ class ServingEngine:
             streams["moe_decode_a2a"] = moe_stream
         return streams
 
+    def parity_pairs(self):
+        """The declared-bitwise form pairs of this engine's slot step
+        (analysis/parity.py — the static half of the replay oracles):
+        paged vs contiguous always, moe_a2a stock vs chunked when the
+        ring can actually run. Each pair's thunks re-trace the step
+        abstractly; ``tools/paritycheck.py`` proves them all."""
+        import dataclasses
+
+        from ..analysis.parity import config_parity_pairs
+
+        srv = dataclasses.asdict(self.serving)
+        srv.pop("fleet", None)
+        raw = {
+            "serving": dict(srv, enabled=True),
+            "tensor_parallel": {"tp_size": self.topology.tp_size},
+            "bf16": {"enabled": jnp.dtype(self.dtype) == jnp.bfloat16},
+        }
+        if self.moe_ep > 1:
+            raw["moe"] = {"enabled": True, "ep_size": self.moe_ep,
+                          "num_experts": self.config.num_experts}
+        return config_parity_pairs(raw, self.engine.model)
+
 
 # ----------------------------------------------------------- lint surface
 def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
                        = None):
     """Abstract serving-step trace for shardlint: (closed_jaxpr,
-    arg_shardings, streams). Nothing materializes — params and the KV
-    arena are ShapeDtypeStructs carrying the real shardings, so the
-    R1–R8 registry (and the cost planner) see exactly the program the
-    serving engine would compile."""
+    arg_shardings, streams, meta). Nothing materializes — params and the
+    KV arena are ShapeDtypeStructs carrying the real shardings, so the
+    R1–R11 registry (and the cost planner) see exactly the program the
+    serving engine would compile.
+
+    ``meta`` carries the trace-stability evidence rule R11 consumes:
+    ``traced_manifest`` (argument name → flat invar index range) and
+    ``required_traced`` — the per-tick host-state vectors (slot
+    occupancy, frontiers, spec_len, page tables, cow_src, per-slot
+    keys) that MUST be traced, never baked, for ``step_traces == 1`` to
+    hold across arbitrary arrival patterns."""
     from ..config import DeepSpeedConfig
 
     cfg = (
@@ -1086,29 +1115,30 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     )
     paged_args = (
         (
-            sds((N, pages_per_slot), jnp.int32, P()),  # page_table
-            sds((N,), jnp.int32, P()),                 # cow_src
+            ("page_table", sds((N, pages_per_slot), jnp.int32, P())),
+            ("cow_src", sds((N,), jnp.int32, P())),
         )
         if paged else ()
     )
-    args = (
-        params,
-        caches,
-        sds((N, V), jnp.bool_, P()),
-        sds((N, W), jnp.int32, P()),
-        sds((N,), jnp.int32, P()),
-        sds((N,), jnp.int32, P()),
+    named_args = (
+        ("params", params),
+        ("caches", caches),
+        ("seen", sds((N, V), jnp.bool_, P())),
+        ("tokens", sds((N, W), jnp.int32, P())),
+        ("num_new", sds((N,), jnp.int32, P())),
+        ("start_pos", sds((N,), jnp.int32, P())),
         *paged_args,
-        sds((N,), jnp.bool_, P()),
-        sds((N,), jnp.bool_, P()),
-        sds((N,), jnp.int32, P()),      # spec_len
-        sds((N,), jnp.int32, P()),      # eos_id
-        sds((N, 2), jnp.uint32, P()),
-        sds((N,), jnp.float32, P()),
-        sds((N,), jnp.int32, P()),
-        sds((N,), jnp.float32, P()),
-        sds((N,), jnp.float32, P()),
+        ("fresh", sds((N,), jnp.bool_, P())),
+        ("sample_flag", sds((N,), jnp.bool_, P())),
+        ("spec_len", sds((N,), jnp.int32, P())),
+        ("eos_id", sds((N,), jnp.int32, P())),
+        ("rng", sds((N, 2), jnp.uint32, P())),
+        ("temperature", sds((N,), jnp.float32, P())),
+        ("top_k", sds((N,), jnp.int32, P())),
+        ("top_p", sds((N,), jnp.float32, P())),
+        ("rep_penalty", sds((N,), jnp.float32, P())),
     )
+    args = tuple(v for _, v in named_args)
     make_fn = make_paged_step_fn if paged else make_step_fn
     step_fn = make_fn(mcfg, dtype, V, cache_shardings=cache_shardings,
                       max_draft=max_draft)
@@ -1159,4 +1189,22 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     )
     if moe_stream:
         streams["moe_decode_a2a"] = moe_stream
-    return closed, arg_shardings, streams
+    # R11 evidence: argument name → flat invar range, plus the per-tick
+    # host-state names the slot engine's ONE-trace contract hinges on
+    manifest, lo = {}, 0
+    for arg_name, leaf_tree in named_args:
+        n = len(jax.tree_util.tree_leaves(leaf_tree))
+        manifest[arg_name] = (lo, lo + n)
+        lo += n
+    required = [
+        "tokens", "num_new", "start_pos", "fresh", "sample_flag",
+        "spec_len", "eos_id", "rng",
+    ]
+    if paged:
+        required += ["page_table", "cow_src"]
+    meta = {
+        "traced_manifest": manifest if lo == len(invars) else {},
+        "required_traced": tuple(required) if lo == len(invars) else (),
+        "moe_a2a_form": moe_form,
+    }
+    return closed, arg_shardings, streams, meta

@@ -38,6 +38,13 @@ trace-ready evidence of one statically-visible bug class:
 - ``reshard_transpose_pair`` R7: transpose∘reshard∘transpose identity
 - ``unhideable_offload_stream`` R8: declared-overlapped stream bigger
   than the compute window
+- ``rng_key_reuse``         R9: one per-slot key consumed by two
+  sampling sites (the clean twin splits first — the serving chain rule)
+- ``reassoc_accum_drift``   R10: a hand-rolled wire ring accumulating
+  dequantized chunks in bf16 (the clean twin dequant-accumulates in
+  f32, the qgZ contract)
+- ``static_arg_per_tick``   R11: a slot step whose ``spec_len`` was
+  baked as a python constant at trace time (the clean twin traces it)
 
 Each has a ``*_clean`` twin proving the rules don't fire on the fixed
 form. All fixtures trace on the 8-device CPU mesh (no execution).
@@ -839,6 +846,111 @@ def unhideable_offload_stream_clean():
     return closed, kw, "R8"
 
 
+# --------------------------------------------------------------------- R9
+def _slot_sampling(reuse: bool):
+    """The serving sampler's key discipline: each slot's chain key is
+    split, one subkey per draw. The hazard consumes ONE key at two
+    sampling sites (the categorical draw and the top-p uniform) — the
+    draws are correlated and the replay chain desynchronizes from the
+    lockstep reference. The clean twin is the chain rule the slot
+    engine ships: split first, consume each subkey once."""
+
+    def prog(logits, key):
+        if reuse:
+            tok = jax.random.categorical(key, logits)
+            u = jax.random.uniform(key, (logits.shape[0],))
+        else:
+            k1, k2 = jax.random.split(key)
+            tok = jax.random.categorical(k1, logits)
+            u = jax.random.uniform(k2, (logits.shape[0],))
+        return tok, u
+
+    logits = jax.ShapeDtypeStruct((4, 16), jnp.float32)
+    return jax.make_jaxpr(prog)(logits, jax.random.PRNGKey(0))
+
+
+def rng_key_reuse():
+    return _slot_sampling(True), {}, "R9"
+
+
+def rng_key_reuse_clean():
+    return _slot_sampling(False), {}, "R9"
+
+
+# ------------------------------------------------------------------ R10 bis
+def _wire_ring_accum(narrow: bool):
+    """A hand-rolled qgZ-style wire accumulate: int8 chunk payloads are
+    dequantized (decode + lane-scale) and folded into a running
+    accumulator chunk by chunk. The hazard runs the accumulator in
+    bf16 — every grouping of the adds lands different rounding, so the
+    declared-bitwise wire pair cannot hold. The clean twin accumulates
+    in f32 and casts once at the end (comm/wires.py's contract)."""
+    acc_dtype = jnp.bfloat16 if narrow else jnp.float32
+
+    def prog(q, scales):
+        acc = q[0].astype(acc_dtype) * scales[0].astype(acc_dtype)
+        for s in range(1, 4):
+            acc = acc + q[s].astype(acc_dtype) * scales[s].astype(acc_dtype)
+        return acc.astype(jnp.bfloat16)
+
+    q = jax.ShapeDtypeStruct((4, 8, 16), jnp.int8)
+    scales = jax.ShapeDtypeStruct((4, 1, 16), jnp.float32)
+    return jax.make_jaxpr(prog)(q, scales)
+
+
+def reassoc_accum_drift():
+    return _wire_ring_accum(True), {}, "R10"
+
+
+def reassoc_accum_drift_clean():
+    return _wire_ring_accum(False), {}, "R10"
+
+
+# --------------------------------------------------------------------- R11
+def _per_tick_step(baked: bool):
+    """The slot step's trace-stability contract: per-tick scheduler
+    state (here ``spec_len``) must be a TRACED input. The hazard bakes
+    it as a python constant — the compiled program is specialized on
+    one tick's value and every later tick retraces (or silently runs
+    with the first tick's state). The lint kwargs carry the traced-args
+    manifest exactly like serving.trace_serving_step supplies it."""
+    BAKED_SPEC_LEN = 2
+
+    def step_baked(tokens, num_new):
+        window = tokens[:, :1 + BAKED_SPEC_LEN]
+        return window.sum(axis=1) + num_new
+
+    def step_traced(tokens, num_new, spec_len):
+        mask = jnp.arange(tokens.shape[1])[None, :] <= spec_len[:, None]
+        return (tokens * mask).sum(axis=1) + num_new
+
+    tokens = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+    num_new = jax.ShapeDtypeStruct((4,), jnp.int32)
+    spec_len = jax.ShapeDtypeStruct((4,), jnp.int32)
+    if baked:
+        closed = jax.make_jaxpr(step_baked)(tokens, num_new)
+        manifest = {"tokens": (0, 1), "num_new": (1, 2)}
+    else:
+        closed = jax.make_jaxpr(step_traced)(tokens, num_new, spec_len)
+        manifest = {"tokens": (0, 1), "num_new": (1, 2),
+                    "spec_len": (2, 3)}
+    kw = {
+        "required_traced": ("num_new", "spec_len"),
+        "traced_manifest": manifest,
+    }
+    return closed, kw
+
+
+def static_arg_per_tick():
+    closed, kw = _per_tick_step(True)
+    return closed, kw, "R11"
+
+
+def static_arg_per_tick_clean():
+    closed, kw = _per_tick_step(False)
+    return closed, kw, "R11"
+
+
 HAZARDS = [
     stacked_dim0_drift,
     slot_cache_carry_drift,
@@ -859,6 +971,9 @@ HAZARDS = [
     autotuner_rung_oom,
     reshard_transpose_pair,
     unhideable_offload_stream,
+    rng_key_reuse,
+    reassoc_accum_drift,
+    static_arg_per_tick,
 ]
 
 CLEAN_TWINS = [
@@ -881,4 +996,7 @@ CLEAN_TWINS = [
     autotuner_rung_oom_clean,
     reshard_transpose_pair_clean,
     unhideable_offload_stream_clean,
+    rng_key_reuse_clean,
+    reassoc_accum_drift_clean,
+    static_arg_per_tick_clean,
 ]

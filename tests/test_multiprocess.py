@@ -21,6 +21,8 @@ import subprocess
 import sys
 import time
 
+from conftest import xfail_legacy_num_cpu_devices
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TRAIN_WORKER = r'''
@@ -146,6 +148,7 @@ def _launch(tmp_path, script_body, script_args, timeout=420):
     return proc, time.monotonic() - t0
 
 
+@xfail_legacy_num_cpu_devices
 def test_two_process_train_and_sharded_checkpoint(tmp_path):
     ckpt = tmp_path / "ckpt"
     proc, _ = _launch(tmp_path, TRAIN_WORKER, [str(ckpt)])
@@ -161,6 +164,7 @@ def test_two_process_train_and_sharded_checkpoint(tmp_path):
     assert (ckpt / tag / "metadata.json").exists()
 
 
+@xfail_legacy_num_cpu_devices
 def test_composed_mesh_save_then_load_at_different_process_count(tmp_path):
     """VERDICT r4 #8: a dp2xtp2 mesh across the 2-process boundary trains,
     ZeRO-1-shards, and checkpoints; the checkpoint then loads into THIS

@@ -229,6 +229,41 @@ def test_gating_eval_accepts_rng_none_bitwise():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_gating_eval_keyfree_certified_statically():
+    """The R9(d) arm on the REAL PR-14 surface: eval gating claims
+    key-free bitwiseness (the runtime twin above proves it bitwise);
+    tracing it with a key handed in and linting under
+    ``claims_keyfree=True`` certifies statically that NO key-consuming
+    site exists on the path — and a gating variant that sneaks eval
+    noise back in (split + sample) is flagged."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.analysis import lint_jaxpr
+    from deepspeed_tpu.moe.sharded_moe import top_k_gating
+
+    logits = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    closed = jax.make_jaxpr(
+        lambda lg, k: top_k_gating(lg, 2, 8, rng=k, train=False,
+                                   noise_std=0.1)
+    )(logits, key)
+    findings = lint_jaxpr(closed, source="gating-eval",
+                          claims_keyfree=True)
+    assert findings == [], [f.format() for f in findings]
+
+    def noisy_eval_gating(lg, k):
+        k, sub = jax.random.split(k)
+        noisy = lg + jax.random.normal(sub, lg.shape) * 0.1
+        return top_k_gating(noisy, 2, 8, rng=None, train=False)
+
+    closed = jax.make_jaxpr(noisy_eval_gating)(logits, key)
+    findings = lint_jaxpr(closed, source="gating-eval-noisy",
+                          claims_keyfree=True)
+    assert any(f.rule == "R9" and "key-free" in f.message
+               for f in findings), [f.format() for f in findings]
+
+
 def test_eval_capacity_static_rule():
     from deepspeed_tpu.moe.sharded_moe import eval_capacity
 

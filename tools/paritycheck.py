@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""paritycheck CLI: statically prove declared-bitwise form pairs.
+
+    python tools/paritycheck.py --all-pairs
+    python tools/paritycheck.py examples/ds_config_serving_paged.json
+    python tools/paritycheck.py --all-pairs --json /tmp/parity.json
+    python tools/paritycheck.py --pair paged --all-pairs
+    python tools/paritycheck.py --mutate examples/ds_config_serving.json
+
+Every headline bitwise contract in this repo is a pair of program FORMS
+(paged vs contiguous slot step, moe_a2a stock vs chunked, TP ring vs
+XLA reference, wire codec vs full-width). The runtime replay oracles
+prove them end-to-end but need minutes of CPU mesh; this tool proves
+the structural half in seconds per pair: both forms are traced
+abstractly, normalized, and compared modulo the pair's declared
+rewrite-equivalence classes (analysis/parity.py, docs/shardlint.md
+"parity certificates"). Exit 1 on any divergence, with the first
+divergent op and both provenances named.
+
+``--mutate`` is the seeded-divergence smoke (wired into CI): form B of
+each serving pair is rebuilt with speculative decoding silently toggled
+— a one-knob behavioral drift the replay suite would need a full replay
+to catch — and the run must DIVERGE (exit 1) naming the changed
+sampling/rng anchors. A --mutate run that exits 0 means the prover lost
+its teeth.
+"""
+
+import argparse
+import copy
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_DIR not in sys.path:
+    sys.path.insert(0, REPO_DIR)
+
+
+def iter_configs(args):
+    for path in args.configs:
+        with open(path) as f:
+            yield os.path.basename(path), json.load(f)
+    if args.all_pairs:
+        ex_dir = os.path.join(REPO_DIR, "examples")
+        for fn in sorted(os.listdir(ex_dir)):
+            if fn.endswith(".json") and not any(
+                fn == os.path.basename(p) for p in args.configs
+            ):
+                with open(os.path.join(ex_dir, fn)) as f:
+                    yield f"examples/{fn}", json.load(f)
+
+
+def _mutate_serving_pair(pair, cfg_dict, model):
+    """Seeded divergence: rebuild form B over a config whose spec
+    section was silently toggled — the one-knob behavior drift the
+    prover must catch (changed verify-window sampling/RNG anchors)."""
+    from deepspeed_tpu.analysis.parity import _serving_trace_thunk
+
+    mut = copy.deepcopy(cfg_dict)
+    srv = dict(mut.get("serving") or {})
+    srv.pop("fleet", None)
+    spec = dict(srv.get("spec") or {})
+    if spec.get("enabled"):
+        spec["max_draft"] = int(spec.get("max_draft", 4)) + 1
+    else:
+        spec = {"enabled": True, "max_draft": 2}
+    srv["spec"] = spec
+    mut["serving"] = srv
+    pair.trace_b = _serving_trace_thunk(mut, model)
+    pair.name += "+mutated-form-b"
+    return pair
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paritycheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("configs", nargs="*", help="ds_config.json paths")
+    ap.add_argument("--all-pairs", action="store_true",
+                    help="prove every pair declared by the shipped "
+                         "examples/*.json exemplar configs")
+    ap.add_argument("--pair", metavar="SUBSTR",
+                    help="only pairs whose name contains SUBSTR")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable certificates here "
+                         "('-' for stdout)")
+    ap.add_argument("--mutate", action="store_true",
+                    help="seeded-divergence smoke: silently toggle spec "
+                         "on form B of each serving pair; the run MUST "
+                         "exit 1 naming the divergent op")
+    ap.add_argument("--budget-s", type=float, default=5.0,
+                    help="per-pair CPU budget (seconds; ISSUE 15 "
+                         "acceptance: <5s)")
+    args = ap.parse_args(argv)
+    if not args.configs and not args.all_pairs:
+        ap.error("no targets: pass config paths and/or --all-pairs")
+
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.analysis.parity import (config_parity_pairs,
+                                               prove_parity)
+    from deepspeed_tpu.config import DeepSpeedConfig
+
+    sys.path.insert(0, os.path.join(REPO_DIR, "tools"))
+    from shardlint import default_model_for
+
+    certs = []
+    over_budget = []
+    n_pairs = 0
+    for name, cfg_dict in iter_configs(args):
+        comm.destroy_process_group()  # each config shapes its own mesh
+        ds = DeepSpeedConfig(copy.deepcopy(cfg_dict))
+        model = default_model_for(ds)
+        pairs = config_parity_pairs(cfg_dict, model)
+        if args.pair:
+            pairs = [p for p in pairs if args.pair in p.name]
+        if args.mutate:
+            pairs = [
+                _mutate_serving_pair(p, cfg_dict, model)
+                for p in pairs if p.name.startswith("serving/")
+            ]
+        for pair in pairs:
+            n_pairs += 1
+            t0 = time.time()
+            cert = prove_parity(pair)
+            print(f"[{name}] {cert.format()}")
+            certs.append({"config": name, **cert.to_dict()})
+            if time.time() - t0 > args.budget_s:
+                over_budget.append((name, pair.name, time.time() - t0))
+    if not n_pairs:
+        # a vacuous run must NOT green the gate: a typo'd --pair filter
+        # or a retargeted config list would otherwise disable it silently
+        print("paritycheck: NO PAIRS selected — nothing was proven")
+    ok = bool(certs) and all(c["ok"] for c in certs) and not over_budget
+    for name, pname, secs in over_budget:
+        print(f"paritycheck: BUDGET {name}/{pname}: {secs:.1f}s > "
+              f"{args.budget_s:.0f}s")
+    payload = {"ok": ok, "pairs": certs}
+    if args.json:
+        text = json.dumps(payload, indent=2)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w") as f:
+                f.write(text)
+    print(
+        "paritycheck: "
+        + ("ALL PAIRS CERTIFIED" if ok else "DIVERGENCE (or budget blown)")
+        + f" [{n_pairs} pair(s)]"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

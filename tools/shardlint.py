@@ -8,8 +8,9 @@
 
 Each config builds an *abstract* engine (abstract_init — state is
 ShapeDtypeStructs, nothing materializes), traces the jitted train step to
-a jaxpr on a CPU mesh, and runs the R1–R8 rule registry
-(docs/shardlint.md). Exit code 1 on any error-severity finding — wire
+a jaxpr on a CPU mesh, and runs the R1–R11 rule registry
+(docs/shardlint.md; e.g. ``--rules R9,R10,R11`` for the paritylint
+subset). Exit code 1 on any error-severity finding — wire
 ``--all-examples`` into the tier-1 flow as the pre-TPU correctness gate
 (it covers every shipped examples/*.json plus the bench.py 410M and 1.5B
 legs, including the double-buffered offload stream).
