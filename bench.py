@@ -112,7 +112,7 @@ def run_tp_overlap_ab():
     dt_serial, _, _ = leg({"tp_size": 2})
     dt_overlap, stream, ring_line = leg(overlap_tp_section(2))
     print(ring_line)
-    _ab_result(
+    return _ab_result(
         "tp_overlap A/B (CPU-mesh validation, not a perf record; "
         "knob default-off pending on-chip A/B)",
         dt_serial, dt_overlap, (stream or {}).get("bytes_per_step", 0),
@@ -171,6 +171,7 @@ def _ab_result(metric, dt_serial, dt_overlap, stream_bytes, extra=None):
     }
     result.update(extra or {})
     print(json.dumps(result))
+    return result
 
 
 def _timed_leg(engine, data, n: int = 5):
@@ -226,7 +227,7 @@ def run_moe_a2a_ab():
     dt_serial, _, _ = leg(False)
     dt_overlap, stream, ring_line = leg(True)
     print(ring_line)
-    _ab_result(
+    return _ab_result(
         "moe_a2a A/B (CPU-mesh validation, not a perf record; knob "
         "default-off pending on-chip A/B)",
         dt_serial, dt_overlap, stream.get("bytes_per_step", 0),
@@ -284,7 +285,7 @@ def run_qgz_ab():
 
     dt_serial, loss_full, _ = leg("fp32", "fp32")
     dt_q, loss_q, wire_bytes = leg("int8", "int8")
-    _ab_result(
+    return _ab_result(
         "qgZ/qwZ wire A/B (CPU-mesh validation, not a perf record; "
         "knobs default-off pending on-chip A/B)",
         dt_serial, dt_q, wire_bytes,
@@ -329,13 +330,26 @@ def run_z3_prefetch_ab():
 
     dt_serial, _ = leg(False)
     dt_overlap, stream = leg(True)
-    _ab_result(
+    return _ab_result(
         "zero3_prefetch A/B (CPU-mesh validation, not a perf record; "
         "knob default-off pending on-chip A/B)",
         dt_serial, dt_overlap, stream.get("bytes_per_step", 0),
         extra={"slots": stream.get("slots"),
                "passes": stream.get("passes")},
     )
+
+
+# Campaign-callable A/B legs: each runs its own CPU-mesh serial-vs-variant
+# measurement and RETURNS the JSON-line dict it prints, so autoplan
+# --campaign (and tests) can invoke the exact CLI protocol
+# programmatically instead of scraping stdout. Keys match the campaign's
+# knob-axis names in deepspeed_tpu/autotuning/campaign.py.
+AB_LEGS = {
+    "tp_overlap": run_tp_overlap_ab,
+    "moe_a2a": run_moe_a2a_ab,
+    "qgz_wires": run_qgz_ab,
+    "z3_prefetch": run_z3_prefetch_ab,
+}
 
 
 def enable_compile_cache():

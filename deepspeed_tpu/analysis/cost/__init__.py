@@ -11,12 +11,16 @@ R8 (overlap-budget) consume it; ``tools/shardplan.py`` is the CLI.
 from .drift import (
     DriftLedger,
     band_for,
+    by_tag as drift_by_tag,
     check as drift_check,
+    entry_tag as drift_entry_tag,
     make_entry as drift_entry,
     recalibration_suggestion,
     summarize as drift_summary,
 )
-from .hardware import HardwareModel, gen_defaults
+from .hardware import (HardwareModel, gen_defaults, gen_from_device_kind,
+                       load_knob_table, lookup_knob_row, model_class,
+                       topology_key)
 from .pipeline import (
     auto_chunk,
     boundary_bytes,
@@ -46,8 +50,10 @@ __all__ = [
     "boundary_bytes",
     "device_bytes",
     "dimspec_from_sharding",
+    "drift_by_tag",
     "drift_check",
     "drift_entry",
+    "drift_entry_tag",
     "drift_summary",
     "format_plan_table",
     "gen_defaults",

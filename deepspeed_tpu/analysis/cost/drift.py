@@ -161,9 +161,13 @@ class DriftLedger:
             )
 
     def load(self, gen: Optional[str] = None,
-             source: Optional[str] = None) -> List[Dict[str, Any]]:
+             source: Optional[str] = None,
+             tag: Optional[str] = None) -> List[Dict[str, Any]]:
         """All parseable rows, newest last; unreadable lines are skipped
-        (the ledger is evidence, never a point of failure)."""
+        (the ledger is evidence, never a point of failure). ``tag``
+        filters on the entry's tag group (``entry_tag``): pass
+        ``"campaign"`` for campaign rows, ``"adhoc"`` for everything
+        untagged."""
         rows: List[Dict[str, Any]] = []
         try:
             with open(self.path) as f:
@@ -183,7 +187,29 @@ class DriftLedger:
             rows = [r for r in rows if r.get("gen") == gen]
         if source is not None:
             rows = [r for r in rows if r.get("source") == source]
+        if tag is not None:
+            rows = [r for r in rows if entry_tag(r) == tag]
         return rows
+
+
+def entry_tag(entry: Dict[str, Any]) -> str:
+    """The entry's band-bookkeeping group: campaign runs tag their rows
+    (``"tag": "campaign"``, tools/autoplan.py --campaign), everything
+    historical/ad-hoc is the ``"adhoc"`` group. Spread statistics never
+    mix groups: a campaign's lattice legs are deliberately heterogeneous
+    (different knob settings price differently — that's the point), so
+    pooling them with ad-hoc single-config runs would poison the
+    relative-pricing medians both gates rely on."""
+    return str(entry.get("tag") or "adhoc")
+
+
+def by_tag(entries: Sequence[Dict[str, Any]]
+           ) -> Dict[str, List[Dict[str, Any]]]:
+    """Entries grouped by their :func:`entry_tag`, insertion-ordered."""
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for r in entries:
+        groups.setdefault(entry_tag(r), []).append(r)
+    return groups
 
 
 def summarize(entries: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
@@ -238,13 +264,20 @@ def check(entries: Sequence[Dict[str, Any]],
                 f"ratio {pk:.3f} outside "
                 f"[{PEAK_BAND[0]}, {PEAK_BAND[1]}]"
             )
-    s = summarize(entries)
-    if s.get("n", 0) >= 2 and s.get("spread") and s["spread"] > spread_band:
-        problems.append(
-            f"survivor ratios disagree by {s['spread']:.2f}x "
-            f"(> {spread_band}x): relative pricing drifted — the ranking "
-            "itself is suspect"
-        )
+    # spread is judged PER TAG GROUP: campaign rows and ad-hoc rows keep
+    # separate band bookkeeping (a campaign's lattice legs are
+    # heterogeneous by design; pooling them with single-config runs
+    # would manufacture false spread alarms — or mask real ones)
+    for tag, rows in by_tag(entries).items():
+        s = summarize(rows)
+        if s.get("n", 0) >= 2 and s.get("spread") and (
+            s["spread"] > spread_band
+        ):
+            problems.append(
+                f"[{tag}] survivor ratios disagree by {s['spread']:.2f}x "
+                f"(> {spread_band}x): relative pricing drifted — the "
+                "ranking itself is suspect"
+            )
     return not problems, problems
 
 

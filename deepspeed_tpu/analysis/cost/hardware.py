@@ -23,9 +23,10 @@ clock instead of pricing the host like a v5e.
 
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass
-from typing import Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 _GIB = float(1 << 30)
 
@@ -66,6 +67,181 @@ def _local_backend_is_cpu() -> bool:
         return False
 
 
+# device_kind substrings, checked IN ORDER ("v5p" must win before the
+# bare "v5" fallback; the lite parts report "TPU v5 lite"/"TPU v6 lite"
+# or the short "v5e"/"v6e" spelling depending on the runtime version)
+_DEVICE_KIND_GENS: Tuple[Tuple[str, str], ...] = (
+    ("v6e", "v6e"),
+    ("v6 lite", "v6e"),
+    ("v6", "v6e"),
+    ("v5e", "v5e"),
+    ("v5 lite", "v5e"),
+    ("v5litepod", "v5e"),
+    ("v5p", "v5p"),
+    ("v5", "v5p"),
+    ("v4", "v4"),
+)
+_WARNED_KINDS: set = set()
+
+
+def gen_from_device_kind(kind: Optional[str]) -> Optional[str]:
+    """Map ``jax.devices()[0].device_kind`` to a `_GEN_TABLE` generation.
+
+    Returns None for kinds the table has no row for (v2/v3, emulators,
+    future chips) — the caller falls back to v5e with a ONE-TIME warning
+    per unknown kind, so a fleet of new chips prices consistently instead
+    of spamming every engine build."""
+    if not kind:
+        return None
+    k = str(kind).lower()
+    for sub, gen in _DEVICE_KIND_GENS:
+        if sub in k:
+            return gen
+    return None
+
+
+def detect_gen() -> str:
+    """The generation `HardwareModel.detect()` prices: the
+    ``PALLAS_AXON_TPU_GEN`` env pin wins; a live TPU backend reads the
+    real ``device_kind`` (unknown kinds → v5e + one-time warning); a CPU
+    backend selects the ``cpu`` envelope; anything else keeps the
+    historical v5e default."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if gen:
+        return gen
+    kind = None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "cpu":
+            return "cpu"
+        if backend == "tpu":
+            kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — backend not initialisable here
+        return "v5e"
+    g = gen_from_device_kind(kind)
+    if g is not None:
+        return g
+    if kind and kind not in _WARNED_KINDS:
+        _WARNED_KINDS.add(kind)
+        try:
+            from ...utils.logging import logger
+
+            logger.warning(
+                f"hardware: unknown TPU device_kind {kind!r} — pricing as "
+                "v5e (add a _GEN_TABLE row / _DEVICE_KIND_GENS entry for "
+                "honest rooflines on this chip)"
+            )
+        except Exception:  # noqa: BLE001 — never block detection on logging
+            pass
+    return "v5e"
+
+
+# ---------------------------------------------------------------------------
+# Per-topology knob default tables (tools/autoplan.py --campaign).
+#
+# A campaign measures the knob lattice on real hardware and emits a table
+# of measured-best defaults keyed by (gen, mesh topology, model class).
+# This module SHIPS that table as data (knob_defaults.json next to this
+# file — empty until the first on-chip campaign lands its rows) and owns
+# the lookup; config.resolve_auto_knobs() consults it whenever a knob is
+# "auto" and applies the staleness gate (drift.check_pair on each entry's
+# recorded evidence). The table is measured evidence, reviewed and
+# committed like a recalibration — the resolver never writes it.
+# ---------------------------------------------------------------------------
+
+KNOB_TABLE_ENV = "DSTPU_KNOB_TABLE"
+_PACKAGED_KNOB_TABLE = os.path.join(os.path.dirname(__file__),
+                                    "knob_defaults.json")
+# measurement-transfer chain: a gen with no measured row falls back to
+# the nearest measured generation's row before giving up (v5e is the
+# fleet's workhorse and the historical pricing default); "cpu" rows are
+# plumbing evidence and never stand in for chips
+GEN_FALLBACKS: Dict[str, Tuple[str, ...]] = {
+    "v6e": ("v5e",),
+    "v5p": ("v5e",),
+    "v4": ("v5e",),
+    "cpu": (),
+}
+
+_AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+
+def topology_key(topology=None) -> str:
+    """Canonical mesh spelling for table keys: the >1-sized axes in a
+    fixed order ("dp4xtp2"); a topology-less session keys on the visible
+    device count ("dp8")."""
+    if topology is None:
+        try:
+            import jax
+
+            n = max(len(jax.devices()), 1)
+        except Exception:  # noqa: BLE001
+            n = 1
+        return f"dp{n}"
+    sizes = dict(getattr(topology, "sizes", None) or {})
+    parts = [f"{a}{int(sizes[a])}" for a in _AXIS_ORDER
+             if int(sizes.get(a, 1)) > 1]
+    return "x".join(parts) or f"dp{int(getattr(topology, 'world_size', 1))}"
+
+
+def model_class(mcfg) -> str:
+    """Coarse model-class bucket for table keys: dense vs moe × analytic
+    parameter-count bucket (s < 1e9 <= m < 1e10 <= l)."""
+    if mcfg is None:
+        return "unknown"
+    moe = bool(getattr(mcfg, "is_moe", False))
+    n = 0.0
+    try:
+        n = float(mcfg.num_params())
+    except Exception:  # noqa: BLE001 — a config without the protocol
+        pass
+    bucket = "s" if n < 1e9 else ("m" if n < 1e10 else "l")
+    return ("moe-" if moe else "dense-") + bucket
+
+
+def load_knob_table(path: Optional[str] = None) -> Dict[str, Any]:
+    """The default-knob table: explicit ``path``, else the
+    ``DSTPU_KNOB_TABLE`` env override, else the packaged data file.
+    Unreadable/corrupt tables are an EMPTY table, never a crash — the
+    conservative off defaults then resolve everywhere."""
+    p = path or os.environ.get(KNOB_TABLE_ENV) or _PACKAGED_KNOB_TABLE
+    try:
+        with open(p) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        return {"version": 1, "entries": []}
+    if not isinstance(table, dict) or not isinstance(
+        table.get("entries"), list
+    ):
+        return {"version": 1, "entries": []}
+    return table
+
+
+def lookup_knob_row(table: Dict[str, Any], gen: str, topo_key: str,
+                    mclass: str) -> Tuple[Optional[Dict[str, Any]], str]:
+    """(row, provenance) for one (gen, topology, model_class) key. Exact
+    gen first, then the GEN_FALLBACKS chain (v6e missing → the v5e row),
+    topology and model class always exact — a measured dp4xtp2 row says
+    nothing about dp8. provenance names where the row came from
+    ("table:v5e/dp4xtp2/dense-s"); a miss is (None, "miss")."""
+    entries = table.get("entries") or []
+
+    def find(g: str) -> Optional[Dict[str, Any]]:
+        for row in entries:
+            if (row.get("gen") == g and row.get("topology") == topo_key
+                    and row.get("model_class") == mclass):
+                return row
+        return None
+
+    for g in (gen, *GEN_FALLBACKS.get(gen, ())):
+        row = find(g)
+        if row is not None:
+            return row, f"table:{g}/{topo_key}/{mclass}"
+    return None, "miss"
+
+
 @dataclass
 class HardwareModel:
     """Per-device capability numbers the roofline and budget checks use."""
@@ -82,12 +258,13 @@ class HardwareModel:
         """Defaults for the local generation + the bench env overrides.
 
         ``PALLAS_AXON_TPU_GEN`` pins the generation; otherwise a live
-        CPU backend selects the ``cpu`` envelope (so lint-mesh plans and
-        drift checks price the machine that actually runs them) and
-        anything else keeps the historical v5e default."""
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN")
-        if not gen:
-            gen = "cpu" if _local_backend_is_cpu() else "v5e"
+        TPU backend reads the real chip generation off
+        ``jax.devices()[0].device_kind`` (unknown kinds fall back to v5e
+        with a one-time warning), a live CPU backend selects the ``cpu``
+        envelope (so lint-mesh plans and drift checks price the machine
+        that actually runs them) and anything else keeps the historical
+        v5e default."""
+        gen = detect_gen()
         d = gen_defaults(gen)
         hbm = d["hbm_bytes"]
         hbm_gb = os.environ.get("SHARDPLAN_HBM_GB")

@@ -1,4 +1,12 @@
 from .autotuner import Autotuner, autotune, result_to_config_patch  # noqa: F401
+from .campaign import (  # noqa: F401
+    Campaign,
+    candidate_knobs,
+    emit_table,
+    run_campaign,
+    serving_ab,
+    verify_roundtrip,
+)
 from .planner_search import (  # noqa: F401
     Candidate,
     PlannedCandidate,

@@ -1,0 +1,507 @@
+"""autoplan --campaign: measure the knob lattice, ship default tables.
+
+The existing ``"auto"`` machinery grew one point solution at a time
+(grad_wire/param_wire legacy resolution, serving kv_cache_dtype, the
+moe_a2a payload threshold). A *campaign* generalizes it to every
+overlap/wire/spec/paged knob at once:
+
+1. **Enumerate** the full knob lattice through
+   :class:`~.planner_search.PlannerSearch` — R6-pruned statically before
+   anything compiles, ranked by roofline, exactly the machinery
+   ``Autotuner._tune_planner`` already trusts;
+2. **Measure** only the ranked top-k through ``Autotuner._measure`` (the
+   one compile+measure loop — the ≤ top-k compile contract holds for a
+   campaign exactly as it does for a tune), banking every (predicted,
+   measured) pair in the drift ledger tagged ``campaign`` so campaign
+   rows keep their own band bookkeeping (:func:`analysis.cost.drift
+   .entry_tag`) and never pollute ad-hoc medians;
+3. **Gate** every knob the measured winner flips on: knobs with a
+   declared :func:`analysis.parity.config_parity_pairs` FormPair must
+   pass :func:`analysis.parity.prove_parity` on the flipped form before
+   their table entry is written; knobs with no static pair (stage-3
+   prefetch, offload double-buffer, spec decode — spec is deliberately
+   unprovable statically, it is the prover's own seeded-divergence
+   smoke) record the named bitwise oracle test that covers them;
+4. **Emit** one default-table row keyed by ``(gen, mesh topology, model
+   class)`` — the table ``cost/hardware.py`` ships as data
+   (``knob_defaults.json``) and :func:`config.resolve_auto_knobs`
+   consults whenever a knob is ``"auto"``. Staleness is enforced at
+   RESOLVE time (drift bands + jax version), so a landed row degrades to
+   the conservative off default when the machine changes, never crashes.
+
+On CPU-only sessions the whole pipeline runs end-to-end against the
+``cpu`` generation on the tiny 410m-lite legs (tier-1 budget) — the
+rows it emits are plumbing evidence (GEN_FALLBACKS never transfers a
+cpu row to a chip), but every moving part is exercised.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import log_dist
+
+CAMPAIGN_TAG = "campaign"
+
+#: Candidate axis → (dotted knob path, parity gate). A string gate names
+#: the declared FormPair prove_parity must certify before the flipped
+#: entry lands; an ``oracle:`` gate names the bitwise test that stands in
+#: where no static pair exists (documented split: docs/autotuning.md).
+AXIS_KNOBS: Dict[str, Tuple[str, str]] = {
+    "tp_overlap": ("tensor_parallel.overlap_comm", "train/tp-ring-vs-xla"),
+    "moe_a2a": ("moe.overlap_a2a", "train/moe-a2a-stock-vs-chunked"),
+    "z3_prefetch": ("zero_optimization.stage3_layer_prefetch",
+                    "oracle:tests/test_zero3_prefetch.py"),
+    "grad_wire": ("zero_optimization.grad_wire",
+                  "train/wire-codec-vs-full-width"),
+    "param_wire": ("zero_optimization.param_wire",
+                   "train/wire-codec-vs-full-width"),
+}
+#: serving-side spelling of the moe_a2a axis (token_budget candidates)
+SERVE_A2A_KNOB = ("serving.moe_a2a", "serving/moe-a2a-stock-vs-chunked")
+#: knobs the campaign A/Bs outside the lattice (identical abstract plans
+#: — the PR-12 duplicate-plan lesson keeps them off the candidate axes)
+DIRECT_AB_KNOBS = {
+    "zero_optimization.offload_double_buffer":
+        "oracle:tests/test_engine.py (bucketed-offload bitwise parity)",
+    "serving.spec": "oracle:tests/test_serving_spec.py (lossless replay)",
+    "serving.paged": "serving/paged-vs-contiguous",
+}
+
+
+def _jax_major_minor() -> Optional[str]:
+    try:
+        import jax
+
+        return ".".join(str(jax.__version__).split(".")[:2])
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class _TopoSizes:
+    """Duck-typed stand-in for MeshTopology in topology_key(): the key
+    must name the mesh the measured engines ACTUALLY ran on, and the
+    campaign usually passes topology=None (initialize() derives the mesh
+    from each candidate config) — so derive the same sizes here without
+    touching the global mesh."""
+
+    def __init__(self, sizes: Dict[str, int], world_size: int):
+        self.sizes = sizes
+        self.world_size = world_size
+
+
+def config_topology(cfg) -> _TopoSizes:
+    """The mesh ``initialize()`` would build for this config (the same
+    fsdp/pp/ep/sp/tp derivation), resolved over the visible devices.
+    ``cfg`` is a DeepSpeedConfig or a raw ds_config dict."""
+    import jax
+
+    from ..comm.topology import ParallelDims
+    from ..config import DeepSpeedConfig
+
+    ds = cfg if isinstance(cfg, DeepSpeedConfig) else DeepSpeedConfig(
+        dict(cfg)
+    )
+    fsdp = 1
+    if ds.zero_config.zero_hpz_partition_size > 1:
+        fsdp = ds.zero_config.zero_hpz_partition_size
+    elif ds.zero_config.mics_shard_size > 0:
+        fsdp = ds.zero_config.mics_shard_size
+    dims = ParallelDims(
+        fsdp=fsdp, pp=ds.pipeline.stages,
+        ep=ds.moe.ep_size if ds.moe.enabled else 1,
+        sp=ds.sequence_parallel.sp_size, tp=ds.tensor_parallel.tp_size,
+    )
+    world = max(len(jax.devices()), 1)
+    return _TopoSizes(dims.resolve(world), world)
+
+
+def candidate_knobs(cand) -> Dict[str, Any]:
+    """The dotted knob settings one lattice candidate pins (only axes
+    that are live for it — None fields are "not an axis here")."""
+    knobs: Dict[str, Any] = {}
+    for axis, (path, _gate) in AXIS_KNOBS.items():
+        v = getattr(cand, axis)
+        if v is None:
+            continue
+        if axis == "moe_a2a" and cand.token_budget is not None:
+            knobs[SERVE_A2A_KNOB[0]] = "chunked" if v else "stock"
+        else:
+            knobs[path] = v
+    return knobs
+
+
+def _knob_gate(path: str) -> str:
+    for axis, (p, gate) in AXIS_KNOBS.items():
+        if p == path:
+            return gate
+    if path == SERVE_A2A_KNOB[0]:
+        return SERVE_A2A_KNOB[1]
+    return DIRECT_AB_KNOBS.get(path, "oracle:unspecified")
+
+
+def _is_on(value) -> bool:
+    """Is this knob value a flip away from the conservative default?"""
+    if isinstance(value, bool):
+        return value
+    return value not in (None, "fp32", "stock", "off")
+
+
+def prove_knob_parity(path: str, cfg_dict: Dict[str, Any], model
+                      ) -> Tuple[bool, str]:
+    """(ok, gate_name) for one flipped-on knob of the winner config.
+
+    Declared FormPairs run the PR-15 prover on the winner's EXACT config
+    (the flipped form's contract, trace thunks and rewrites all come from
+    ``config_parity_pairs``); oracle-gated knobs pass by naming their
+    bitwise test — the campaign never writes an ungated entry."""
+    gate = _knob_gate(path)
+    if gate.startswith("oracle:"):
+        return True, gate
+    from ..analysis.parity import config_parity_pairs, prove_parity
+    from ..config import DeepSpeedConfig
+
+    try:
+        cfg = DeepSpeedConfig(dict(cfg_dict))
+        pairs = [p for p in config_parity_pairs(cfg, model)
+                 if p.name == gate]
+        if not pairs:
+            # the flipped form declared no pair under this config (e.g.
+            # the wire axis resolved to fp32 after all) — nothing to
+            # certify means nothing to gate
+            return True, f"{gate} (no pair declared — form inert here)"
+        cert = prove_parity(pairs[0])
+        return bool(cert.ok), gate
+    except Exception as e:  # noqa: BLE001 — a prover crash must read as
+        # "not certified", never as a campaign crash
+        log_dist(f"campaign: parity prover failed for {path}: {e}")
+        return False, gate
+
+
+def _winner_twin(planned, winner_pc, path: str):
+    """The winner's twin on one knob axis: the planned candidate whose
+    settings equal the winner's everywhere EXCEPT ``path``. Both arms
+    always exist statically (the lattice is a full cross product), so a
+    twin that was ranked out of the measured top-k still contributes its
+    PREDICTED step time as evidence."""
+    want = candidate_knobs(winner_pc.cand)
+    for pc in planned:
+        if pc.cand is winner_pc.cand or pc.plan is None:
+            continue
+        k = candidate_knobs(pc.cand)
+        if set(k) != set(want):
+            continue
+        if k.get(path) == want.get(path):
+            continue
+        if all(k[p] == want[p] for p in want if p != path):
+            if (pc.cand.zero == winner_pc.cand.zero
+                    and pc.cand.remat == winner_pc.cand.remat
+                    and pc.cand.micro == winner_pc.cand.micro
+                    and pc.cand.token_budget == winner_pc.cand.token_budget):
+                return pc
+    return None
+
+
+class Campaign:
+    """One end-to-end campaign over a (model, base_config, topology)."""
+
+    def __init__(self, model, base_config: Dict[str, Any], topology=None,
+                 *, sample_batch_fn=None, hardware=None,
+                 top_k: Optional[int] = None,
+                 hbm_budget_bytes: Optional[float] = None,
+                 wire_codecs: Sequence[str] = ("fp32", "int8"),
+                 remat_policies: Sequence[str] = ("none",),
+                 drift_ledger_path: Optional[str] = None):
+        from ..analysis.cost import HardwareModel
+        from .autotuner import Autotuner
+
+        self.model = model
+        self.base_config = dict(base_config)
+        self.topology = topology
+        self.hardware = hardware or HardwareModel.detect()
+        self.tuner = Autotuner(model, self.base_config, topology=topology,
+                               sample_batch_fn=sample_batch_fn)
+        self.top_k = int(top_k if top_k is not None else self.tuner.top_k)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.wire_codecs = tuple(wire_codecs)
+        self.remat_policies = tuple(remat_policies)
+        self.drift_ledger_path = (drift_ledger_path
+                                  or self.tuner.drift_ledger_path)
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> Dict[str, Any]:
+        """enumerate → measure top-k → bank tagged pairs → gate → row.
+
+        Returns ``{"search", "measured", "row", "banked", "skipped"}``;
+        ``row`` is the default-table row (or None when nothing measured),
+        ready for :func:`emit_table`."""
+        from ..analysis.cost import drift
+        from .planner_search import PlannerSearch
+
+        search = PlannerSearch(
+            self.model, self.base_config, self.topology,
+            top_k=self.top_k,
+            hbm_budget_bytes=(self.hbm_budget_bytes
+                              if self.hbm_budget_bytes is not None
+                              else self.tuner._resolved_budget()),
+            hardware=self.hardware,
+            wire_codecs=self.wire_codecs,
+            remat_policies=self.remat_policies,
+            tuner=self.tuner,
+        )
+        result = search.search()
+        if not result.survivors:
+            raise RuntimeError(
+                "campaign: every lattice rung is statically over the HBM "
+                "budget — nothing to measure\n" + result.explain()
+            )
+        ledger = drift.DriftLedger(self.drift_ledger_path)
+        measured: List[Dict[str, Any]] = []
+        banked = 0
+        for pc in result.top_k:
+            cfg = search._candidate_config(pc.cand)
+            tput = self.tuner._measure(pc.cand.micro, pc.cand.remat, cfg=cfg)
+            if tput is None:
+                log_dist(f"campaign: {pc.cand.label()} OOMed at runtime "
+                         "(backstop prune)")
+                continue
+            measured_step_s = pc.tokens_per_step / tput
+            rec = {
+                "pc": pc, "cfg": cfg, "throughput": tput,
+                "measured_step_s": measured_step_s,
+                "knobs": candidate_knobs(pc.cand),
+            }
+            measured.append(rec)
+            try:  # the ledger is evidence, never a point of failure
+                ledger.append(drift.make_entry(
+                    pc.plan, measured_step_s,
+                    source=f"campaign:{pc.cand.label()}",
+                    extra={"tag": CAMPAIGN_TAG,
+                           "throughput": round(tput, 1),
+                           "knobs": rec["knobs"]},
+                ))
+                banked += 1
+            except Exception as e:  # noqa: BLE001
+                log_dist(f"campaign: drift ledger append failed: {e}")
+        out: Dict[str, Any] = {
+            "search": result, "measured": measured, "banked": banked,
+            "row": None, "skipped": {},
+        }
+        if not measured:
+            log_dist("campaign: no lattice rung survived measurement — "
+                     "no table row emitted")
+            return out
+        winner = max(measured, key=lambda r: r["throughput"])
+        out["row"] = self._emit_row(winner, measured, result.planned,
+                                    out["skipped"])
+        return out
+
+    # ------------------------------------------------------------- evidence
+    def _emit_row(self, winner, measured, planned, skipped) -> Dict[str, Any]:
+        """One table row from the measured winner: its knob settings plus
+        per-knob evidence (the winner's banked pair; the twin arm's
+        measured pair when the twin made top-k, its predicted step time
+        otherwise — both arms always exist statically)."""
+        from ..analysis.cost import model_class, topology_key
+
+        pc = winner["pc"]
+        knobs = dict(winner["knobs"])
+        evidence: Dict[str, Dict[str, Any]] = {}
+        measured_by_cand = {id(r["pc"].cand): r for r in measured}
+        for path, value in list(knobs.items()):
+            ok, gate = (True, _knob_gate(path))
+            if _is_on(value):
+                ok, gate = prove_knob_parity(path, winner["cfg"], self.model)
+                if not ok:
+                    # gate 1 failed: the flipped default never lands —
+                    # drop the knob from the row (resolution then takes
+                    # the conservative off default) and say why
+                    skipped[path] = f"parity not certified ({gate})"
+                    log_dist(f"campaign: {path}={value!r} NOT shipped — "
+                             f"parity gate {gate} failed")
+                    del knobs[path]
+                    continue
+            ev: Dict[str, Any] = {
+                "predicted_step_s": pc.predicted_step_s,
+                "measured_step_s": round(winner["measured_step_s"], 6),
+                "parity": gate,
+            }
+            twin = _winner_twin(planned, pc, path)
+            if twin is not None:
+                trec = measured_by_cand.get(id(twin.cand))
+                ev["twin"] = {
+                    "value": candidate_knobs(twin.cand).get(path),
+                    "predicted_step_s": twin.predicted_step_s,
+                    "measured_step_s": (round(trec["measured_step_s"], 6)
+                                        if trec else None),
+                    "evidence": "measured" if trec else "predicted",
+                }
+            evidence[path] = ev
+        row = {
+            "gen": self.hardware.gen,
+            # key on the mesh the measured engines actually ran on: when
+            # the campaign let initialize() derive the topology from the
+            # config, derive the identical sizes here — a fresh engine
+            # resolving later must hit this row, not a "dp8" mismatch
+            "topology": topology_key(
+                self.topology if self.topology is not None
+                else config_topology(winner["cfg"])
+            ),
+            "model_class": model_class(getattr(self.model, "config", None)),
+            "knobs": knobs,
+            "evidence": evidence,
+            "winner": pc.cand.label(),
+            "throughput": round(winner["throughput"], 1),
+            "jax": _jax_major_minor(),
+            "created": round(time.time(), 1),
+        }
+        return row
+
+
+def run_campaign(model, base_config, topology=None, **kw) -> Dict[str, Any]:
+    """One-call spelling (tools/autoplan.py --campaign)."""
+    return Campaign(model, base_config, topology, **kw).run()
+
+
+# --------------------------------------------------------------------- table
+def emit_table(rows: Sequence[Dict[str, Any]], path: str) -> Dict[str, Any]:
+    """Merge campaign rows into the table at ``path`` (same-key rows are
+    replaced, everything else kept) and write it back. Returns the
+    merged table."""
+    from ..analysis.cost import load_knob_table
+
+    table = load_knob_table(path) if os.path.exists(path) else {
+        "version": 1, "entries": []
+    }
+    def key(r):
+        return (r.get("gen"), r.get("topology"), r.get("model_class"))
+
+    fresh = {key(r): r for r in rows}
+    entries = [r for r in table.get("entries", [])
+               if key(r) not in fresh]
+    entries.extend(rows)
+    table["entries"] = entries
+    table.setdefault("version", 1)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return table
+
+
+def verify_roundtrip(base_config: Dict[str, Any], table_path: str,
+                     model=None, topology=None, hardware=None
+                     ) -> Dict[str, Any]:
+    """The campaign's closing assertion: a FRESH all-"auto" config
+    resolved against the emitted table must land on the campaign's
+    winner settings. Returns the resolution report plus the resolved
+    knob values keyed by dotted path — the caller (CLI / CI) compares
+    them against the emitted row."""
+    from ..analysis.cost import load_knob_table
+    from ..config import AUTO, DeepSpeedConfig, resolve_auto_knobs
+
+    cfg_dict = dict(base_config)
+    cfg_dict.pop("autotuning", None)
+    # spell every campaign-owned bool knob "auto"
+    tp = dict(cfg_dict.get("tensor_parallel") or {})
+    if int(tp.get("tp_size", 1)) > 1:
+        tp["overlap_comm"] = AUTO
+        cfg_dict["tensor_parallel"] = tp
+    zo = dict(cfg_dict.get("zero_optimization") or {})
+    if zo:
+        zo["stage3_layer_prefetch"] = AUTO
+        zo["offload_double_buffer"] = AUTO
+        zo["grad_wire"] = AUTO
+        zo["param_wire"] = AUTO
+        cfg_dict["zero_optimization"] = zo
+    moe = dict(cfg_dict.get("moe") or {})
+    if moe.get("enabled"):
+        moe["overlap_a2a"] = AUTO
+        cfg_dict["moe"] = moe
+    sv = dict(cfg_dict.get("serving") or {})
+    if sv.get("enabled"):
+        sv["paged"] = AUTO
+        sv["spec"] = AUTO
+        sv["moe_a2a"] = AUTO
+        cfg_dict["serving"] = sv
+    cfg = DeepSpeedConfig(cfg_dict)
+    report = resolve_auto_knobs(
+        cfg, hardware=hardware,
+        model_config=getattr(model, "config", None),
+        # same mesh derivation as the campaign's row key / initialize()
+        topology=topology if topology is not None else config_topology(cfg),
+        table=load_knob_table(table_path),
+    )
+    resolved = {
+        "tensor_parallel.overlap_comm":
+            cfg.tensor_parallel.overlap_comm.enabled,
+        "zero_optimization.offload_double_buffer":
+            cfg.zero_config.offload_double_buffer,
+        "zero_optimization.stage3_layer_prefetch":
+            cfg.zero_config.stage3_layer_prefetch,
+        "zero_optimization.grad_wire": cfg.zero_config.grad_wire,
+        "zero_optimization.param_wire": cfg.zero_config.param_wire,
+        "moe.overlap_a2a": cfg.moe.overlap_a2a.enabled,
+        "serving.spec": cfg.serving.spec.enabled,
+        "serving.paged": cfg.serving.paged,
+        "serving.moe_a2a": cfg.serving.moe_a2a,
+    }
+    return {"report": report, "resolved": resolved, "config": cfg}
+
+
+# ---------------------------------------------------------------- serving AB
+def serving_ab(model, serving_section: Dict[str, Any], knob: str,
+               values: Sequence[Any] = (False, True), *,
+               requests: int = 8, new_tokens: int = 8,
+               engine_kwargs: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+    """A/B one serving knob: two ServingEngines, identical replayed
+    request sets, wall-clock tokens/s per arm. The campaign's serving
+    legs and ``tools/bench_serve.py --campaign-ab`` both call this — one
+    loop, two front doors."""
+    import numpy as np
+
+    from ..serving import Request, ServingEngine
+
+    arms = []
+    for v in values:
+        sv = dict(serving_section)
+        if knob == "spec":
+            spec = dict(sv.get("spec") or {})
+            spec["enabled"] = v
+            sv["spec"] = spec
+        else:
+            sv[knob] = v
+        srv = ServingEngine(model=model, serving=sv,
+                            **dict(engine_kwargs or {}))
+        rng = np.random.RandomState(0)
+        reqs = [
+            Request(request_id=f"r{i}",
+                    prompt=[int(t) for t in rng.randint(
+                        1, 100, size=4 + (i % 3))],
+                    max_new_tokens=new_tokens, temperature=0.0)
+            for i in range(requests)
+        ]
+        t0 = time.perf_counter()
+        for r in reqs:
+            srv.submit(r)
+        finished = srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        toks = sum(len(st.tokens) for st in finished)
+        arms.append({
+            "value": v,
+            "tokens": toks,
+            "dt_s": round(dt, 6),
+            "tokens_per_s": round(toks / dt, 1) if dt > 0 else None,
+            "tokens_by_request": {
+                st.request.request_id: list(st.tokens) for st in finished
+            },
+        })
+    same = (arms[0]["tokens_by_request"] == arms[1]["tokens_by_request"]
+            if len(arms) == 2 else None)
+    return {"knob": knob, "arms": arms, "tokens_equal": same}

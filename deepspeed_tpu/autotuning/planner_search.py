@@ -258,6 +258,7 @@ class PlannerSearch:
                  token_budgets: Sequence[int] = DEFAULT_TOKEN_BUDGETS,
                  include_tiles: bool = False,
                  wire_codecs: Sequence[str] = ("fp32", "int8"),
+                 remat_policies: Optional[Sequence[str]] = None,
                  tuner=None):
         from .autotuner import Autotuner
 
@@ -269,6 +270,11 @@ class PlannerSearch:
         self.mesh_shapes = list(mesh_shapes or [])
         self.token_budgets = tuple(token_budgets)
         self.include_tiles = include_tiles
+        # remat axis restriction (the campaign pins ("none",) so the
+        # lattice stays about the overlap/wire/prefetch knobs the default
+        # table ships); None = the full REMAT_POLICIES ladder as before
+        self.remat_policies = (tuple(remat_policies)
+                               if remat_policies is not None else None)
         # the wire-codec axis (ISSUE 12, comm/wires.py): grad_wire on
         # stage>=1 rungs, param_wire on stage-3 rungs — every combination
         # priced statically before any compile. ("fp32",) collapses it.
@@ -392,7 +398,9 @@ class PlannerSearch:
                     if int(stage) == 3 and len(wires) > 1 and data_live
                     else [None]
                 )
-                for pol in REMAT_POLICIES:
+                for pol in (self.remat_policies
+                            if self.remat_policies is not None
+                            else REMAT_POLICIES):
                     for mb in mbs:
                         for ov in overlap_axis:
                             for a2a in a2a_axis:
@@ -427,7 +435,10 @@ class PlannerSearch:
             self.tuner._zero_patch = prev
         if cand.tp_overlap is not None:
             tp = dict(cfg.get("tensor_parallel") or {})
-            oc = dict(tp.get("overlap_comm") or {})
+            # the base may spell the knob as a bool or "auto" (shorthand
+            # section) — the axis value replaces it either way
+            oc = tp.get("overlap_comm")
+            oc = dict(oc) if isinstance(oc, dict) else {}
             oc["enabled"] = bool(cand.tp_overlap)
             tp["overlap_comm"] = oc
             cfg["tensor_parallel"] = tp
@@ -441,7 +452,8 @@ class PlannerSearch:
                 cfg["serving"] = sv
             else:
                 moe = dict(cfg.get("moe") or {})
-                oa = dict(moe.get("overlap_a2a") or {})
+                oa = moe.get("overlap_a2a")
+                oa = dict(oa) if isinstance(oa, dict) else {}
                 oa["enabled"] = bool(cand.moe_a2a)
                 moe["overlap_a2a"] = oa
                 cfg["moe"] = moe

@@ -30,6 +30,21 @@ def _get(d: Dict[str, Any], key: str, default=None):
     return default if v == AUTO else v
 
 
+def _tristate(v):
+    """Normalize a bool-or-"auto" knob: "auto" (and any other string)
+    survives parsing — strings are judged by ``_check_tristate`` at
+    validation so a typo like "ture" raises instead of silently
+    coercing to True; non-strings collapse to bool (JSON 0/1)."""
+    return v if isinstance(v, str) else bool(v)
+
+
+def _check_tristate(name: str, v) -> None:
+    if not (isinstance(v, bool) or v == AUTO):
+        raise DeepSpeedConfigError(
+            f"{name} must be true, false or \"auto\", got {v!r}"
+        )
+
+
 @dataclass
 class OptimizerConfig:
     """Parity: "optimizer" section (deepspeed/runtime/config.py)."""
@@ -123,8 +138,9 @@ class ZeroConfig:
     # i+1's pinned-host optimizer state while layer i's math runs, write
     # layer i-1's result back concurrently (runtime/bucketed_opt.py).
     # Costs one extra layer slice of HBM; off until on-chip parity + A/B
-    # land. "sub_group_prefetch" is accepted as an alias.
-    offload_double_buffer: bool = False
+    # land. "sub_group_prefetch" is accepted as an alias. "auto" defers to
+    # the measured knob-default table (resolve_auto_knobs).
+    offload_double_buffer: Any = False  # bool | "auto"
     # one-layer-ahead stage-3 parameter all-gather prefetch: the layer
     # scan carries a rotating two-slot gathered-params buffer (the PR-1
     # offload_double_buffer pattern applied to the fwd/bwd scan), so
@@ -134,7 +150,8 @@ class ZeroConfig:
     # params are excluded automatically — their "gather" is a no-op.
     # Off by default pending an on-chip A/B; "zero3_prefetch" is
     # accepted as an alias. Ignored (with a log line) when stage != 3.
-    stage3_layer_prefetch: bool = False
+    # "auto" defers to the measured knob-default table.
+    stage3_layer_prefetch: Any = False  # bool | "auto"
     offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = field(default_factory=OffloadConfig)
     stage3_max_live_parameters: int = 10**9
@@ -182,6 +199,8 @@ class ZeroConfig:
     def validate(self) -> None:
         if self.stage not in (0, 1, 2, 3):
             raise DeepSpeedConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        for knob in ("offload_double_buffer", "stage3_layer_prefetch"):
+            _check_tristate(f"zero_optimization.{knob}", getattr(self, knob))
         for off in (self.offload_optimizer, self.offload_param):
             if off.device not in ("none", "cpu", "nvme", None):
                 raise DeepSpeedConfigError(f"offload device must be none|cpu|nvme, got {off.device}")
@@ -234,6 +253,17 @@ class ActivationCheckpointingConfig:
     profile: bool = False
     policy: str = "none"  # none | full | dots_saveable | dots_flash | attn_only | offload_host
 
+    def validate(self) -> None:
+        # reject unknown policies at construction — otherwise the typo only
+        # surfaces as a KeyError deep inside the traced train step
+        from .runtime.activation_checkpointing import _POLICIES
+
+        if self.policy not in (None, "none") and self.policy not in _POLICIES:
+            raise DeepSpeedConfigError(
+                f"activation_checkpointing.policy {self.policy!r} is unknown; "
+                f"have none, {', '.join(sorted(_POLICIES))}"
+            )
+
 
 @dataclass
 class PipelineConfig:
@@ -261,7 +291,7 @@ class MoEOverlapA2AConfig:
     pure-XLA reference path on CPU meshes for both dispatch modes
     (tests/test_moe_a2a_overlap.py)."""
 
-    enabled: bool = False
+    enabled: Any = False  # bool | "auto" (measured knob-default table)
     # capacity chunks per exchange (the ring/FFN pipelining granularity:
     # chunk k+1's hops fly while chunk k's expert matmuls run); uneven
     # splits allowed, never changes numerics for top_k <= 2
@@ -271,6 +301,7 @@ class MoEOverlapA2AConfig:
     bidirectional: bool = False
 
     def validate(self) -> None:
+        _check_tristate("moe.overlap_a2a.enabled", self.enabled)
         if int(self.chunks) < 1:
             raise DeepSpeedConfigError(
                 f"moe.overlap_a2a.chunks must be >= 1, got {self.chunks}"
@@ -296,8 +327,8 @@ class MoEConfig:
 
     def __post_init__(self):
         # _parse_dc is shallow: the nested section arrives as a dict (or a
-        # bare bool, the overlap_comm spelling) — normalize here
-        if isinstance(self.overlap_a2a, bool):
+        # bare bool / "auto", the overlap_comm spelling) — normalize here
+        if isinstance(self.overlap_a2a, bool) or self.overlap_a2a == AUTO:
             self.overlap_a2a = MoEOverlapA2AConfig(enabled=self.overlap_a2a)
         elif isinstance(self.overlap_a2a, dict):
             self.overlap_a2a = _parse_dc(MoEOverlapA2AConfig,
@@ -315,7 +346,7 @@ class OverlapCommConfig:
     unquantized rings are oracle-verified bitwise against the XLA
     reference path on a CPU mesh (tests/test_tp_overlap.py)."""
 
-    enabled: bool = False
+    enabled: Any = False  # bool | "auto" (measured knob-default table)
     # matmul sub-chunks per ring step (scheduling granularity for the
     # DMA/MXU overlap; never changes numerics — uneven splits allowed)
     chunks: int = 1
@@ -331,6 +362,7 @@ class OverlapCommConfig:
     quantized_hops: bool = False
 
     def validate(self) -> None:
+        _check_tristate("tensor_parallel.overlap_comm.enabled", self.enabled)
         if int(self.chunks) < 1:
             raise DeepSpeedConfigError(
                 f"tensor_parallel.overlap_comm.chunks must be >= 1, got "
@@ -359,7 +391,7 @@ class SpecDecodeConfig:
     spec-on reproduces spec-off token-for-token (greedy AND
     sampled-with-shared-keys)."""
 
-    enabled: bool = False
+    enabled: Any = False  # bool | "auto" (measured knob-default table)
     max_draft: int = 4     # k: draft tokens per decode slot per step (the
                            # verify window is k+1 rows of the slot's chunk)
     draft: str = "ngram"   # draft source; "ngram" = host-side n-gram /
@@ -483,10 +515,12 @@ class ServingConfig:
     max_tokens: int = 1024       # per-request prompt+output cap (slot KV
                                  # capacity; clamped to model max_seq_len)
     kv_cache_dtype: str = "auto"  # auto | bf16 | bfloat16 | int8
-    paged: bool = False          # block-paged KV arena (vLLM / FastGen
+    paged: Any = False           # block-paged KV arena (vLLM / FastGen
                                  # blocked-KV): a global page pool + per-slot
                                  # page tables replaces the contiguous
-                                 # [max_slots, capacity] regions
+                                 # [max_slots, capacity] regions. bool |
+                                 # "auto" (measured knob-default table;
+                                 # forced True under fleet disaggregation)
     page_size: int = 16          # tokens per KV page (paged mode)
     num_pages: int = 0           # physical pages in the pool; 0 = auto
                                  # (max_slots * pages_per_slot — no
@@ -517,6 +551,9 @@ class ServingConfig:
         # as dicts both from DeepSpeedConfig and from ServingEngine(
         # serving={...}) — normalize here so every consumer sees the
         # dataclasses
+        if isinstance(self.spec, bool) or self.spec == AUTO:
+            # bare bool / "auto" spelling, like overlap_comm
+            self.spec = SpecDecodeConfig(enabled=self.spec)
         if isinstance(self.spec, dict):
             self.spec = _parse_dc(SpecDecodeConfig, self.spec)
         if isinstance(self.fleet, dict):
@@ -569,9 +606,12 @@ class ServingConfig:
                 "serving.moe_a2a must be auto|stock|chunked, got "
                 f"{self.moe_a2a!r}"
             )
-        if self.spec.enabled:
-            # a disabled spec section is inert (the engine maps it to
-            # max_draft = 0), so its field ranges only matter when on
+        _check_tristate("serving.spec.enabled", self.spec.enabled)
+        _check_tristate("serving.paged", self.paged)
+        if self.spec.enabled is True:
+            # a disabled (or still-"auto") spec section is inert (the
+            # engine maps it to max_draft = 0; "auto" only resolves on
+            # when the budget fits), so its field ranges only matter on
             self.spec.validate()
             if int(self.spec.max_draft) + 1 > int(self.token_budget):
                 raise DeepSpeedConfigError(
@@ -582,7 +622,10 @@ class ServingConfig:
                 )
         if self.fleet.enabled:
             self.fleet.validate()
-            if int(self.fleet.prefill_replicas) > 0 and not self.paged:
+            if int(self.fleet.prefill_replicas) > 0 and self.paged is False:
+                # "auto" is fine here: resolve_auto_knobs forces paged on
+                # under prefill/decode disaggregation before the engine
+                # reads it
                 raise DeepSpeedConfigError(
                     "serving.fleet.prefill_replicas > 0 requires "
                     "serving.paged: the prefill→decode KV handoff is a "
@@ -932,10 +975,12 @@ class DeepSpeedConfig:
         zo = dict(d.get("zero_optimization") or {})
         if "sub_group_prefetch" in zo:  # alias (sub_group_size kin)
             zo.setdefault("offload_double_buffer", zo["sub_group_prefetch"])
-        zo["offload_double_buffer"] = bool(zo.get("offload_double_buffer", False))
+        zo["offload_double_buffer"] = _tristate(
+            zo.get("offload_double_buffer", False)
+        )
         if "zero3_prefetch" in zo:  # alias (the ROADMAP/ISSUE spelling)
             zo.setdefault("stage3_layer_prefetch", zo.pop("zero3_prefetch"))
-        zo["stage3_layer_prefetch"] = bool(
+        zo["stage3_layer_prefetch"] = _tristate(
             zo.get("stage3_layer_prefetch", False)
         )
         zo["offload_optimizer"] = _parse_dc(OffloadConfig, zo.get("offload_optimizer"))
@@ -954,8 +999,9 @@ class DeepSpeedConfig:
             # alias only — the rest of the section (overlap_comm) survives
             tp["tp_size"] = tp.pop("autotp_size")
         oc = tp.get("overlap_comm")
-        if isinstance(oc, bool):
+        if isinstance(oc, bool) or oc == AUTO:
             # the spelling zero_optimization.overlap_comm users expect
+            # ("auto" rides the same shorthand)
             oc = {"enabled": oc}
         tp["overlap_comm"] = _parse_dc(OverlapCommConfig, oc)
         self.tensor_parallel = _parse_dc(TensorParallelConfig, tp)
@@ -1067,16 +1113,18 @@ class DeepSpeedConfig:
         self.moe.overlap_a2a.validate()
         self.serving.validate()
         if (
-            self.tensor_parallel.overlap_comm.enabled
+            self.tensor_parallel.overlap_comm.enabled is True
             and self.pipeline.stages > 1
         ):
+            # "auto" is exempt: resolve_auto_knobs gates the flip on
+            # pp <= 1, so an auto knob can never resolve into this state
             raise DeepSpeedConfigError(
                 "tensor_parallel.overlap_comm is not supported with pipeline "
                 "parallelism (the decomposed matmul is a full-manual "
                 "shard_map and cannot nest inside the pipeline's manual "
                 "schedule); the runtime also falls back per call site"
             )
-        if self.moe.overlap_a2a.enabled and self.pipeline.stages > 1:
+        if self.moe.overlap_a2a.enabled is True and self.pipeline.stages > 1:
             raise DeepSpeedConfigError(
                 "moe.overlap_a2a is not supported with pipeline parallelism "
                 "(the decomposed all-to-all is a full-manual shard_map and "
@@ -1088,6 +1136,7 @@ class DeepSpeedConfig:
                 "random_ltd is not supported with pipeline parallelism (the "
                 "token-subset gather would cross pp stage boundaries)"
             )
+        self.activation_checkpointing.validate()
         self.sparse_attention.validate()
         self.checkpoint.validate()
         self.steptrace.validate()
@@ -1144,3 +1193,275 @@ def _parse_dc(cls, section):
         return cls(**known)
     except TypeError as e:  # pragma: no cover
         raise DeepSpeedConfigError(f"bad config section for {cls.__name__}: {e}")
+
+
+# ---------------------------------------------------------------------------
+# "auto" knob resolution against the measured per-topology default table
+# (analysis/cost/knob_defaults.json, emitted by tools/autoplan.py
+# --campaign). ONE resolver for every overlap/wire/spec/paged knob,
+# generalizing the point solutions that grew one at a time
+# (resolved_grad_wire, kv_cache_dtype-"auto", resolve_moe_a2a_form):
+# initialize() and ServingEngine.__init__ call it once, before any
+# engine code reads the knobs, so a knob is either a concrete value or
+# a deliberate downstream "auto" (wires / kv dtype / serving moe_a2a
+# keep their existing late resolution when the table has no fresh row).
+#
+# Trust model: a table value only applies when (a) the knob is
+# applicable to this config (an inapplicable flip silently stays off —
+# a dp-only mesh can't use tp overlap no matter what a row says),
+# (b) the row's recorded evidence is FRESH — its (predicted, measured)
+# pair still sits inside the generation's drift band (drift.check_pair)
+# and its recorded jax major.minor matches the running one. Stale rows
+# resolve to the conservative off default with a one-time named
+# warning, never a crash.
+# ---------------------------------------------------------------------------
+
+#: every knob path resolve_auto_knobs() owns (docs/autotuning.md
+#: "Campaign mode" documents the schema these dotted paths key into)
+AUTO_KNOB_PATHS = (
+    "tensor_parallel.overlap_comm",
+    "zero_optimization.offload_double_buffer",
+    "zero_optimization.stage3_layer_prefetch",
+    "zero_optimization.grad_wire",
+    "zero_optimization.param_wire",
+    "moe.overlap_a2a",
+    "serving.spec",
+    "serving.paged",
+    "serving.moe_a2a",
+    "serving.kv_cache_dtype",
+)
+
+_AUTO_WARNED: set = set()
+
+
+def _jax_major_minor() -> Optional[str]:
+    try:
+        import jax
+
+        return ".".join(str(jax.__version__).split(".")[:2])
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key in _AUTO_WARNED:
+        return
+    _AUTO_WARNED.add(key)
+    try:
+        from .utils.logging import logger
+
+        logger.warning(msg)
+    except Exception:  # noqa: BLE001 — never block resolution on logging
+        pass
+
+
+def _fresh_table_value(row, provenance: str, path: str, gen: str):
+    """(value, source) for one knob path out of a table row, applying the
+    staleness gate; (None, reason) when the row has nothing fresh."""
+    from .analysis.cost import drift
+
+    if row is None or path not in (row.get("knobs") or {}):
+        return None, "miss"
+    value = row["knobs"][path]
+    jx = row.get("jax")
+    now = _jax_major_minor()
+    if jx and now and jx != now:
+        _warn_once(
+            f"{path}:{provenance}:jax",
+            f"auto knob {path}: {provenance} was measured on jax {jx} but "
+            f"this is jax {now} — using the conservative off default "
+            "(re-run tools/autoplan.py --campaign to refresh the table)",
+        )
+        return None, f"stale-jax:{provenance}"
+    ev = (row.get("evidence") or {}).get(path) or {}
+    pred = ev.get("predicted_step_s")
+    meas = ev.get("measured_step_s")
+    if meas is not None:
+        verdict = drift.check_pair(pred, meas, row.get("gen", gen))
+        if not verdict["ok"]:
+            _warn_once(
+                f"{path}:{provenance}:band",
+                f"auto knob {path}: {provenance} evidence is outside the "
+                f"{verdict['gen']} drift band {verdict['band']} (ratio "
+                f"{verdict['ratio']}) — using the conservative off default "
+                "(re-run tools/autoplan.py --campaign to refresh the table)",
+            )
+            return None, f"stale-band:{provenance}"
+    return value, provenance
+
+
+def resolve_auto_knobs(cfg, hardware=None, model_config=None,
+                       topology=None, table=None) -> Dict[str, Dict[str, Any]]:
+    """Resolve every ``"auto"`` knob on ``cfg`` in place from the measured
+    knob-default table; returns (and attaches as ``cfg.auto_resolution``)
+    a ``{path: {"value", "source"}}`` report.
+
+    ``cfg`` is a :class:`DeepSpeedConfig` (training + serving knobs) or a
+    bare :class:`ServingConfig` (serving knobs only). Explicit values are
+    never touched — only knobs spelled ``"auto"`` resolve, and only to a
+    table value that is applicable AND fresh (see the module comment);
+    everything else lands on the conservative off default. Idempotent:
+    a second call is a no-op because nothing is "auto" anymore (except
+    the deliberately-deferred wire/kv/moe_a2a autos, whose downstream
+    resolution is itself deterministic).
+    """
+    report: Dict[str, Dict[str, Any]] = {}
+    full = isinstance(cfg, DeepSpeedConfig)
+    srv = cfg.serving if full else (cfg if isinstance(cfg, ServingConfig)
+                                    else None)
+
+    def pending() -> List[str]:
+        p = []
+        if full:
+            if cfg.tensor_parallel.overlap_comm.enabled == AUTO:
+                p.append("tensor_parallel.overlap_comm")
+            zc = cfg.zero_config
+            if zc.offload_double_buffer == AUTO:
+                p.append("zero_optimization.offload_double_buffer")
+            if zc.stage3_layer_prefetch == AUTO:
+                p.append("zero_optimization.stage3_layer_prefetch")
+            if zc.grad_wire == AUTO:
+                p.append("zero_optimization.grad_wire")
+            if zc.param_wire == AUTO:
+                p.append("zero_optimization.param_wire")
+            if cfg.moe.overlap_a2a.enabled == AUTO:
+                p.append("moe.overlap_a2a")
+        if srv is not None:
+            if srv.spec.enabled == AUTO:
+                p.append("serving.spec")
+            if srv.paged == AUTO:
+                p.append("serving.paged")
+            if srv.moe_a2a == AUTO:
+                p.append("serving.moe_a2a")
+            if srv.kv_cache_dtype == AUTO:
+                p.append("serving.kv_cache_dtype")
+        return p
+
+    pend = pending()
+    if not pend:
+        if full:
+            cfg.auto_resolution = report
+        return report
+
+    from .analysis.cost import hardware as hwmod
+
+    hw = hardware if hardware is not None else hwmod.HardwareModel.detect()
+    tab = table if table is not None else hwmod.load_knob_table()
+    row, provenance = hwmod.lookup_knob_row(
+        tab, hw.gen, hwmod.topology_key(topology), hwmod.model_class(model_config)
+    )
+
+    def fresh(path):
+        return _fresh_table_value(row, provenance, path, hw.gen)
+
+    def resolve_bool(path: str, applicable: bool, apply) -> None:
+        value, source = fresh(path)
+        if not applicable:
+            apply(False)
+            report[path] = {"value": False, "source": "inapplicable"}
+            return
+        if isinstance(value, bool):
+            apply(value)
+            report[path] = {"value": value, "source": source}
+        else:
+            apply(False)
+            report[path] = {"value": False, "source": f"off-default:{source}"}
+
+    if full:
+        tp_live = int(cfg.tensor_parallel.tp_size) > 1
+        pp_live = int(cfg.pipeline.stages) > 1
+        zc = cfg.zero_config
+        moe = cfg.moe
+        if "tensor_parallel.overlap_comm" in pend:
+            resolve_bool(
+                "tensor_parallel.overlap_comm",
+                tp_live and not pp_live,
+                lambda v: setattr(cfg.tensor_parallel.overlap_comm,
+                                  "enabled", v),
+            )
+        if "zero_optimization.offload_double_buffer" in pend:
+            resolve_bool(
+                "zero_optimization.offload_double_buffer",
+                bool(zc.offload_optimizer.enabled),
+                lambda v: setattr(zc, "offload_double_buffer", v),
+            )
+        if "zero_optimization.stage3_layer_prefetch" in pend:
+            resolve_bool(
+                "zero_optimization.stage3_layer_prefetch",
+                int(zc.stage) == 3,
+                lambda v: setattr(zc, "stage3_layer_prefetch", v),
+            )
+        if "moe.overlap_a2a" in pend:
+            resolve_bool(
+                "moe.overlap_a2a",
+                bool(moe.enabled) and int(moe.ep_size) > 1 and not pp_live,
+                lambda v: setattr(moe.overlap_a2a, "enabled", v),
+            )
+        # wire codecs: a fresh measured codec wins; otherwise "auto"
+        # survives for the legacy resolution (resolved_grad_wire /
+        # resolved_param_wire — zero_quantized_* spellings), which is
+        # already deterministic and fp32-conservative
+        for path, attr, applicable in (
+            ("zero_optimization.grad_wire", "grad_wire", int(zc.stage) >= 1),
+            ("zero_optimization.param_wire", "param_wire",
+             int(zc.stage) == 3),
+        ):
+            if path not in pend:
+                continue
+            value, source = fresh(path)
+            if (applicable and isinstance(value, str)
+                    and value in ZeroConfig._WIRE_CODECS and value != AUTO):
+                setattr(zc, attr, value)
+                report[path] = {"value": value, "source": source}
+            else:
+                report[path] = {
+                    "value": getattr(zc, f"resolved_{attr}")(),
+                    "source": "legacy-auto" if applicable
+                    else "inapplicable",
+                }
+
+    if srv is not None:
+        if "serving.spec" in pend:
+            budget_fits = (int(srv.spec.max_draft) + 1
+                           <= int(srv.token_budget))
+            resolve_bool(
+                "serving.spec",
+                budget_fits,
+                lambda v: setattr(srv.spec, "enabled", v),
+            )
+        if "serving.paged" in pend:
+            if srv.fleet.enabled and int(srv.fleet.prefill_replicas) > 0:
+                # prefill/decode disaggregation REQUIRES the paged arena
+                # (the KV handoff is a page-table transfer) — forced on
+                # regardless of the table
+                srv.paged = True
+                report["serving.paged"] = {
+                    "value": True, "source": "forced:fleet-disaggregation"
+                }
+            else:
+                resolve_bool("serving.paged", True,
+                             lambda v: setattr(srv, "paged", v))
+        if "serving.moe_a2a" in pend:
+            value, source = fresh("serving.moe_a2a")
+            if value in ("stock", "chunked"):
+                srv.moe_a2a = value
+                report["serving.moe_a2a"] = {"value": value, "source": source}
+            else:
+                # the payload-threshold resolution in serving/engine.py
+                # (resolve_moe_a2a_form) stays authoritative
+                report["serving.moe_a2a"] = {"value": AUTO,
+                                             "source": "threshold-auto"}
+        if "serving.kv_cache_dtype" in pend:
+            value, source = fresh("serving.kv_cache_dtype")
+            if value in ("int8", "bf16", "bfloat16"):
+                srv.kv_cache_dtype = value
+                report["serving.kv_cache_dtype"] = {"value": value,
+                                                    "source": source}
+            else:
+                # engine default (bf16 KV) stays authoritative
+                report["serving.kv_cache_dtype"] = {"value": AUTO,
+                                                    "source": "engine-auto"}
+
+    if full:
+        cfg.auto_resolution = report
+    return report

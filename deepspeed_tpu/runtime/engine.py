@@ -187,6 +187,17 @@ def initialize(
 
     cfg.resolve_batch_sizes(topology.data_shard_size)
 
+    # resolve every "auto" overlap/wire/spec/paged knob from the measured
+    # knob-default table (config.resolve_auto_knobs) BEFORE any engine
+    # code reads them — engines see concrete values only (the
+    # deliberately-deferred wire/kv autos keep their downstream
+    # resolution when the table has no fresh row)
+    from ..config import resolve_auto_knobs
+
+    resolve_auto_knobs(
+        cfg, model_config=getattr(model, "config", None), topology=topology
+    )
+
     if cfg.pipeline.stages > 1 or getattr(model, "is_pipeline_module", False):
         from .pipe.engine import PipelineEngine
 

@@ -461,6 +461,18 @@ class ServingEngine:
             serving = ServingConfig()
         elif isinstance(serving, dict):
             serving = _parse_dc(ServingConfig, serving)
+        # resolve "auto" spec/paged/moe_a2a/kv knobs from the measured
+        # knob-default table before ANY read below (spec_enabled, paged,
+        # the pre-engine kv dtype kwarg) — conservative off on a miss
+        from ..config import resolve_auto_knobs
+
+        resolve_auto_knobs(
+            serving,
+            model_config=(getattr(engine, "config", None)
+                          if engine is not None
+                          else getattr(model, "config", None)),
+            topology=getattr(engine, "topology", None),
+        )
         serving.validate()
         self.serving = serving
         if engine is None:
@@ -1040,6 +1052,11 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     srv = cfg.serving
     tp = max(int(cfg.tensor_parallel.tp_size), 1)
     mcfg = model.config
+    # same "auto" resolution the live engine applies — the linted program
+    # and the served program must read identical knob values
+    from ..config import resolve_auto_knobs
+
+    resolve_auto_knobs(cfg, model_config=mcfg, topology=topology)
     # MoE serving configs lint on the ep mesh they would serve on: the
     # expert exchange only exists in the traced program when the ep axis
     # does (serving_ep_size — the ONE moe.ep_size clamp)

@@ -78,6 +78,18 @@ class Router:
             serving = ServingConfig()
         elif isinstance(serving, dict):
             serving = _parse_dc(ServingConfig, serving)
+        # "auto" knobs resolve ONCE here, before replicas are built —
+        # every replica must read the same concrete values (and paged is
+        # forced on under prefill/decode disaggregation)
+        from ...config import resolve_auto_knobs
+
+        resolve_auto_knobs(
+            serving,
+            model_config=(getattr(engine, "config", None)
+                          if engine is not None
+                          else getattr(model, "config", None)),
+            topology=getattr(engine, "topology", None),
+        )
         serving.validate()
         fleet = serving.fleet
         # constructing a Router IS opting into the fleet: validate the

@@ -5,6 +5,7 @@
     python tools/autoplan.py --leg 410m --hbm-gb 16 --explain
     python tools/autoplan.py --leg 410m --dryrun-mesh 8x1,4x2,2x4
     python tools/autoplan.py --check --leg 410m-lite --hbm-gb 1 --top-k 2
+    python tools/autoplan.py --campaign --gen cpu --leg 410m-lite --tp 2
 
 Default mode is **static**: enumerate the config's full candidate space
 (zero stage × offload × remat × micro-batch, tp-overlap and serving
@@ -27,6 +28,16 @@ band (docs/autotuning.md "Drift bands"). Legs:
 - ``410m-lite`` the same llama family scaled to hidden 512 / 4 layers /
                 seq 256: the CPU-mesh CI leg (a couple of minutes total)
 - ``1b``        the 1.4B ZeRO-3 offload leg (static modes only)
+
+``--campaign`` is the knob-lattice measurement campaign (docs/
+autotuning.md "Campaign mode"): enumerate every overlap/wire/prefetch
+knob combination through the same R6-pruned, roofline-ranked search,
+compile+measure only the top-k, bank every pair into the drift ledger
+tagged ``campaign``, and emit a default-table row keyed by (gen, mesh
+topology, model class) that ``config.py`` consults whenever one of
+those knobs is spelled ``"auto"``. The run closes its own loop: a
+fresh all-"auto" config must re-resolve onto the emitted winner or the
+exit code is 1. Runs end-to-end on a CPU host with ``--gen cpu``.
 """
 
 import argparse
@@ -222,6 +233,12 @@ def run_check(args, model, base_config) -> int:
         "ok": not problems,
         "problems": problems,
     }
+    # campaign-tagged pairs live in the same ledger but never mix into
+    # the ad-hoc medians above (drift.check groups spread per tag) —
+    # report them as their own section so table provenance is auditable
+    campaign_rows = ledger.load(tag="campaign")
+    if campaign_rows:
+        summary["campaign_drift"] = drift.summarize(campaign_rows)
     recal = drift.recalibration_suggestion(ledger.load())
     if recal:
         summary["recalibration"] = recal
@@ -229,6 +246,103 @@ def run_check(args, model, base_config) -> int:
     if problems:
         for p in problems:
             print(f"autoplan --check FAIL: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_campaign_mode(args, model, base_config) -> int:
+    """--campaign: enumerate the knob lattice, measure the top-k, bank
+    campaign-tagged drift pairs, emit the default-table row, then prove
+    the loop closes — a FRESH all-"auto" config resolved against the
+    emitted table must land on the winner's settings. Exit 1 when the
+    re-resolution misses or disagrees."""
+    import numpy as np
+
+    from deepspeed_tpu.autotuning import (
+        emit_table,
+        run_campaign,
+        serving_ab,
+        verify_roundtrip,
+    )
+
+    S = model.config.max_seq_len
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(0)
+
+    def sample_batch(global_batch):
+        return {"input_ids": rng.randint(0, vocab, size=(global_batch, S))}
+
+    ledger_path = args.ledger or os.path.join(REPO_DIR, "perf",
+                                              "drift.jsonl")
+    table_path = args.table or os.path.join(
+        REPO_DIR, "deepspeed_tpu", "analysis", "cost", "knob_defaults.json"
+    )
+    base_config = dict(base_config)
+    if args.tp > 1:
+        # arm the tp_overlap lattice axis (and the dpXxtpY topology the
+        # row is keyed on)
+        base_config["tensor_parallel"] = dict(
+            base_config.get("tensor_parallel") or {}, tp_size=args.tp
+        )
+    budget = args.hbm_gb * (1 << 30) if args.hbm_gb is not None else None
+    out = run_campaign(
+        model, base_config,
+        sample_batch_fn=sample_batch, top_k=args.top_k,
+        hbm_budget_bytes=budget, drift_ledger_path=ledger_path,
+    )
+    print(out["search"].explain())
+    problems = []
+    row = out["row"]
+    if row is None:
+        problems.append("no lattice rung survived measurement — no table "
+                        "row emitted")
+    else:
+        emit_table([row], table_path)
+        rt = verify_roundtrip(base_config, table_path, model=model)
+        resolved = rt["resolved"]
+        for path, want in row["knobs"].items():
+            if not isinstance(want, bool):
+                continue  # wire codecs resolve downstream ("legacy-auto")
+            got = resolved.get(path)
+            if got is not want:
+                problems.append(
+                    f"re-resolution mismatch: {path} resolved to {got!r}, "
+                    f"campaign shipped {want!r}"
+                )
+    serve = None
+    if args.serve:
+        # the serving half of the lattice: off-vs-on A/B per knob through
+        # the same loop tools/bench_serve.py --campaign-ab uses; arms must
+        # emit identical tokens (the knobs are layout/scheduling, never
+        # numerics)
+        serve = {}
+        section = {"max_slots": 4, "token_budget": 16, "max_tokens": 32,
+                   "queue_limit": 64, "request_timeout_s": 1e9}
+        for knob in ("paged", "spec"):
+            res = serving_ab(model, section, knob, requests=4, new_tokens=4)
+            serve[knob] = res
+            if not res.get("tokens_equal", False):
+                problems.append(
+                    f"serving A/B arms for {knob!r} emitted different "
+                    "tokens — knob is not numerics-neutral"
+                )
+    summary = {
+        "leg": args.leg or (args.configs[0] if args.configs else "?"),
+        "row": ({k: row[k] for k in ("gen", "topology", "model_class",
+                                     "knobs", "winner", "throughput")}
+                if row else None),
+        "skipped": out["skipped"],
+        "banked": out["banked"],
+        "table": table_path,
+        "ledger": ledger_path,
+        **({"serve": serve} if serve is not None else {}),
+        "ok": not problems,
+        "problems": problems,
+    }
+    print(json.dumps(summary))
+    if problems:
+        for p in problems:
+            print(f"autoplan --campaign FAIL: {p}", file=sys.stderr)
         return 1
     return 0
 
@@ -262,6 +376,23 @@ def main(argv=None) -> int:
                     help="drift-regression gate: compile+measure top-k, "
                          "bank (predicted, measured) pairs, exit 1 when "
                          "any pair leaves the documented band")
+    ap.add_argument("--campaign", action="store_true",
+                    help="knob-lattice campaign: enumerate, measure "
+                         "top-k, bank campaign-tagged drift pairs, emit "
+                         "the per-(gen, topology, model-class) default "
+                         "table row and prove a fresh all-\"auto\" config "
+                         "re-resolves onto the winner (exit 1 otherwise)")
+    ap.add_argument("--table", metavar="PATH",
+                    help="--campaign: default-table target (default: the "
+                         "packaged deepspeed_tpu/analysis/cost/"
+                         "knob_defaults.json)")
+    ap.add_argument("--tp", type=int, default=1, metavar="N",
+                    help="--campaign: tensor-parallel degree; N>1 arms "
+                         "the tp_overlap lattice axis on a dp x tp CPU "
+                         "host mesh")
+    ap.add_argument("--serve", action="store_true",
+                    help="--campaign: also A/B the serving knobs (paged, "
+                         "spec) through autotuning.serving_ab")
     ap.add_argument("--steps", type=int, default=1,
                     help="--check: measured steps per trial (default 1)")
     ap.add_argument("--trials", type=int, default=1,
@@ -275,9 +406,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not args.configs and not args.leg:
         ap.error("no target: pass a ds_config.json or --leg")
-    if args.check and not args.leg:
-        ap.error("--check needs a --leg (it must build a runnable "
-                 "model + batch)")
+    if (args.check or args.campaign) and not args.leg:
+        ap.error(f"--{'check' if args.check else 'campaign'} needs a "
+                 "--leg (it must build a runnable model + batch)")
     if args.gen:
         # the planner's HardwareModel.detect() honors this env pin — the
         # same knob bench.py uses, so a dryrun and a bench price alike
@@ -296,6 +427,8 @@ def main(argv=None) -> int:
                                              args.max_micro)
         model = shardlint_cli.default_model_for(DeepSpeedConfig(base_config))
 
+    if args.campaign:
+        return run_campaign_mode(args, model, base_config)
     if args.check:
         return run_check(args, model, base_config)
 

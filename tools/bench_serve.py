@@ -60,6 +60,7 @@ replay harness.
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -490,6 +491,12 @@ def main(argv=None) -> int:
     ap.add_argument("--routing", default="prefix",
                     choices=["prefix", "least_loaded", "round_robin"],
                     help="fleet routing policy (--replicas > 1)")
+    ap.add_argument("--campaign-ab", metavar="KNOB", default=None,
+                    choices=["paged", "spec", "moe_a2a"],
+                    help="A/B one serving knob off-vs-on through "
+                         "deepspeed_tpu.autotuning.serving_ab (the "
+                         "campaign's serving leg) and print the result "
+                         "JSON instead of running the replay")
     args = ap.parse_args(argv)
     if (args.hw_queue_depth is not None or args.hw_ttft_p95 is not None
             or args.postmortem or args.check_health):
@@ -507,6 +514,19 @@ def main(argv=None) -> int:
         ap.error("--ep > 1 needs --model mixtral (expert parallelism "
                  "shards MoE expert banks)")
     model = _build_model(args)
+    if args.campaign_ab:
+        from deepspeed_tpu.autotuning import serving_ab
+
+        values = (
+            ("stock", "chunked") if args.campaign_ab == "moe_a2a"
+            else (False, True)
+        )
+        result = serving_ab(
+            model, _serving_section(args), args.campaign_ab,
+            values=values, requests=min(args.requests, 8),
+        )
+        print(json.dumps(result))
+        return 0
     topology = None
     if args.tp > 1 or args.ep > 1:
         n = max(args.tp, 1) * max(args.ep, 1)
