@@ -71,6 +71,12 @@ class LintContext:
         ``overlapped`` stream against the step's compute window.
     hardware: a cost.HardwareModel (None → detect per-generation
         defaults + bench env overrides).
+    link_kinds: mesh axis → "ici" | "dcn" (MeshTopology.link_kinds on
+        hybrid meshes). The planner prices collectives whose ring
+        traverses a DCN-tagged axis at ``hardware.dcn_bw``, and rules
+        R12/R13 read it to spot flat collectives / overlap claims that
+        ignore the slow fabric. Empty (the default) means an all-ICI
+        mesh — R12/R13 are silent and pricing is unchanged.
     donated_invars: flat top-level invar indices donated at the jit
         boundary (the planner's buffer-reuse credit follows R4's
         donation reasoning).
@@ -106,6 +112,7 @@ class LintContext:
     hbm_budget_bytes: Optional[float] = None
     streams: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     hardware: Any = None
+    link_kinds: Dict[str, str] = field(default_factory=dict)
     donated_invars: Sequence[int] = ()
     invar_groups: Dict[str, Tuple[int, int]] = field(default_factory=dict)
     claims_keyfree: bool = False

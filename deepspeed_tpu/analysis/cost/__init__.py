@@ -36,6 +36,7 @@ from .planner import (
     plan_for_context,
     plan_jaxpr,
     scale_plan_micro,
+    split_link_bytes,
 )
 from .walk import JaxprWalker, WalkStats, device_bytes, dimspec_from_sharding
 
@@ -65,5 +66,6 @@ __all__ = [
     "plan_jaxpr",
     "recalibration_suggestion",
     "scale_plan_micro",
+    "split_link_bytes",
     "stash_boundaries",
 ]

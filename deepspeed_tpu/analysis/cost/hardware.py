@@ -8,6 +8,8 @@ shardplan prediction price the same machine:
                              or "cpu" for the host-mesh envelope)
 - ``BENCH_HOST_BW_GBS``      host<->HBM DMA link, GB/s (offload stream)
 - ``BENCH_ICI_BW_GBS``       per-link ICI bandwidth, GB/s (ring hops)
+- ``BENCH_DCN_BW_GBS``       per-device inter-pod DCN bandwidth, GB/s
+                             (hybrid-mesh hops over DCN-tagged axes)
 - ``SHARDPLAN_HBM_GB``       per-device HBM capacity budget override
 
 Everything is per *device*: the planner's byte and flop counts are
@@ -43,19 +45,24 @@ _GEN_TABLE = {
     "cpu": (3e9, 16 * _GIB, 3e9),
 }
 
-# per-generation (ici GB/s, host-DMA GB/s) defaults when the bench env
-# overrides are unset; TPU gens share the historical 45/32 numbers
-_LINK_TABLE = {"cpu": (1.0, 3.0)}
-_LINK_DEFAULT = (45.0, 32.0)
+# per-generation (ici GB/s, host-DMA GB/s, dcn GB/s) defaults when the
+# bench env overrides are unset; TPU gens share the historical 45/32
+# numbers. The DCN figure is deliberately conservative: ~25 Gbit/s of
+# per-device share on the inter-pod data-center network (a 4x-NIC host
+# divided over its chips), an order of magnitude under any ICI link —
+# the gap that makes the 2-hop hierarchical forms win.
+_LINK_TABLE = {"cpu": (1.0, 3.0, 0.25)}
+_LINK_DEFAULT = (45.0, 32.0, 3.125)
 
 
 def gen_defaults(gen: str) -> Dict[str, float]:
     """The raw table row for one generation (the constants the drift
     ledger's recalibration suggestion talks about)."""
     flops, hbm, hbm_bw = _GEN_TABLE.get(gen, _GEN_TABLE["v5e"])
-    ici, host = _LINK_TABLE.get(gen, _LINK_DEFAULT)
+    ici, host, dcn = _LINK_TABLE.get(gen, _LINK_DEFAULT)
     return {"peak_flops": flops, "hbm_bytes": hbm, "hbm_bw": hbm_bw,
-            "ici_bw": ici * 1e9, "host_bw": host * 1e9}
+            "ici_bw": ici * 1e9, "host_bw": host * 1e9,
+            "dcn_bw": dcn * 1e9}
 
 
 def _local_backend_is_cpu() -> bool:
@@ -131,7 +138,10 @@ def detect_gen() -> str:
             logger.warning(
                 f"hardware: unknown TPU device_kind {kind!r} — pricing as "
                 "v5e (add a _GEN_TABLE row / _DEVICE_KIND_GENS entry for "
-                "honest rooflines on this chip)"
+                "honest rooflines on this chip; the v5e fallback also "
+                "supplies its DCN figure, so hybrid-mesh inter-pod hops "
+                "price at the conservative default instead of this "
+                "chip's real DCN share — set BENCH_DCN_BW_GBS to pin it)"
             )
         except Exception:  # noqa: BLE001 — never block detection on logging
             pass
@@ -171,7 +181,10 @@ _AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "ep", "tp")
 def topology_key(topology=None) -> str:
     """Canonical mesh spelling for table keys: the >1-sized axes in a
     fixed order ("dp4xtp2"); a topology-less session keys on the visible
-    device count ("dp8")."""
+    device count ("dp8"). DCN-tagged axes carry their link class in the
+    spelling ("dp4dcnxfsdp2") so a hybrid 4×2 factorization can never
+    share a table row with the flat all-ICI dp4xfsdp2 mesh — measured
+    knob defaults are fabric-specific evidence."""
     if topology is None:
         try:
             import jax
@@ -181,8 +194,11 @@ def topology_key(topology=None) -> str:
             n = 1
         return f"dp{n}"
     sizes = dict(getattr(topology, "sizes", None) or {})
-    parts = [f"{a}{int(sizes[a])}" for a in _AXIS_ORDER
-             if int(sizes.get(a, 1)) > 1]
+    kinds = dict(getattr(topology, "link_kinds", None) or {})
+    parts = [
+        f"{a}{int(sizes[a])}" + ("dcn" if kinds.get(a) == "dcn" else "")
+        for a in _AXIS_ORDER if int(sizes.get(a, 1)) > 1
+    ]
     return "x".join(parts) or f"dp{int(getattr(topology, 'world_size', 1))}"
 
 
@@ -252,6 +268,7 @@ class HardwareModel:
     hbm_bw: float = 819e9             # HBM bandwidth, bytes/s
     ici_bw: float = 45e9              # per-link ICI bandwidth, bytes/s
     host_bw: float = 32e9             # host DMA link, bytes/s
+    dcn_bw: float = 3.125e9           # per-device inter-pod DCN, bytes/s
 
     @classmethod
     def detect(cls) -> "HardwareModel":
@@ -272,6 +289,7 @@ class HardwareModel:
             hbm = float(hbm_gb) * _GIB
         ici_env = os.environ.get("BENCH_ICI_BW_GBS")
         host_env = os.environ.get("BENCH_HOST_BW_GBS")
+        dcn_env = os.environ.get("BENCH_DCN_BW_GBS")
         return cls(
             gen=gen,
             peak_flops=d["peak_flops"],
@@ -279,4 +297,5 @@ class HardwareModel:
             hbm_bw=d["hbm_bw"],
             ici_bw=float(ici_env) * 1e9 if ici_env else d["ici_bw"],
             host_bw=float(host_env) * 1e9 if host_env else d["host_bw"],
+            dcn_bw=float(dcn_env) * 1e9 if dcn_env else d["dcn_bw"],
         )

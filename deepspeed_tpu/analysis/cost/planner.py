@@ -77,9 +77,14 @@ class Plan:
     hbm_traffic_bytes: float = 0.0
     ici_bytes: Dict[str, float] = field(default_factory=dict)
     ici_hops: Dict[str, int] = field(default_factory=dict)
+    # the subset of ici_bytes whose ring traverses a DCN-tagged axis
+    # (hybrid meshes — priced at hardware.dcn_bw, not ici_bw)
+    dcn_bytes: Dict[str, float] = field(default_factory=dict)
+    link_kinds: Dict[str, str] = field(default_factory=dict)
     compute_s: float = 0.0
     hbm_s: float = 0.0
     ici_s: float = 0.0
+    dcn_s: float = 0.0
     est_step_s: float = 0.0
     streams: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     seconds: float = 0.0             # planner wall time
@@ -110,9 +115,11 @@ class Plan:
             "hbm_traffic_bytes": round(self.hbm_traffic_bytes),
             "ici_bytes": {k: round(v) for k, v in self.ici_bytes.items()},
             "ici_hops": dict(self.ici_hops),
+            "dcn_bytes": {k: round(v) for k, v in self.dcn_bytes.items()},
             "compute_s": round(self.compute_s, 6),
             "hbm_s": round(self.hbm_s, 6),
             "ici_s": round(self.ici_s, 6),
+            "dcn_s": round(self.dcn_s, 6),
             "est_step_s": round(self.est_step_s, 6),
             "hbm_budget_gib": round(self.hardware.hbm_bytes / _GIB, 3),
             "seconds": round(self.seconds, 3),
@@ -150,6 +157,44 @@ def format_plan_table(plans: Sequence[Plan]) -> str:
     return "\n".join(lines)
 
 
+def split_link_bytes(
+    ici_bytes: Dict[str, float], link_kinds: Dict[str, str]
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Classify each collective's wire bytes by the links its ring
+    traverses: (ici-only, dcn-crossing). Keys are the walker's
+    "+"-joined axis sets; a ring whose axis set touches ANY DCN-tagged
+    axis is throttled end-to-end by the slow fabric (its hops are
+    synchronized — the flat form's whole payload crawls at dcn_bw, which
+    is exactly why the 2-hop decomposition wins). Axis-less keys ("?")
+    stay ICI."""
+    if not link_kinds:
+        return dict(ici_bytes), {}
+    ici: Dict[str, float] = {}
+    dcn: Dict[str, float] = {}
+    for key, b in ici_bytes.items():
+        axes = key.split("+")
+        bucket = dcn if any(link_kinds.get(a) == "dcn" for a in axes) else ici
+        bucket[key] = b
+    return ici, dcn
+
+
+def _reprice_links(plan: Plan) -> None:
+    """Recompute the wire seconds + roofline max from the plan's byte
+    dicts (shared by plan_jaxpr and scale_plan_micro)."""
+    hw = plan.hardware
+    ici_only = {
+        k: v for k, v in plan.ici_bytes.items() if k not in plan.dcn_bytes
+    }
+    plan.ici_s = max(
+        (b / hw.ici_bw for b in ici_only.values()), default=0.0
+    ) if hw.ici_bw else 0.0
+    dcn_bw = float(getattr(hw, "dcn_bw", 0.0) or 0.0)
+    plan.dcn_s = max(
+        (b / dcn_bw for b in plan.dcn_bytes.values()), default=0.0
+    ) if dcn_bw else 0.0
+    plan.est_step_s = max(plan.compute_s, plan.hbm_s, plan.ici_s, plan.dcn_s)
+
+
 def plan_jaxpr(
     closed_jaxpr,
     *,
@@ -159,6 +204,7 @@ def plan_jaxpr(
     invar_groups: Optional[Dict[str, Tuple[int, int]]] = None,
     streams: Optional[Dict[str, Dict[str, Any]]] = None,
     hardware: Optional[HardwareModel] = None,
+    link_kinds: Optional[Dict[str, str]] = None,
     source: str = "<jaxpr>",
 ) -> Plan:
     """Budget one traced program. All inputs are the same evidence
@@ -244,12 +290,11 @@ def plan_jaxpr(
                 plan.offload_inflight_bytes,
                 float(s.get("per_device_inflight_bytes", 0.0)),
             )
+    plan.link_kinds = dict(link_kinds or {})
+    _, plan.dcn_bytes = split_link_bytes(plan.ici_bytes, plan.link_kinds)
     plan.compute_s = st.flops / hw.peak_flops if hw.peak_flops else 0.0
     plan.hbm_s = st.hbm_bytes / hw.hbm_bw if hw.hbm_bw else 0.0
-    plan.ici_s = max(
-        (b / hw.ici_bw for b in st.ici_bytes.values()), default=0.0
-    ) if hw.ici_bw else 0.0
-    plan.est_step_s = max(plan.compute_s, plan.hbm_s, plan.ici_s)
+    _reprice_links(plan)
     plan.seconds = time.time() - t0
     return plan
 
@@ -280,16 +325,15 @@ def scale_plan_micro(plan: Plan, factor: float,
         hbm_traffic_bytes=plan.hbm_traffic_bytes * f,
         ici_bytes={k: v * f for k, v in plan.ici_bytes.items()},
         ici_hops=dict(plan.ici_hops),
+        dcn_bytes={k: v * f for k, v in plan.dcn_bytes.items()},
+        link_kinds=dict(plan.link_kinds),
         streams=dict(plan.streams),
         seconds=0.0,
     )
     hw = scaled.hardware
     scaled.compute_s = scaled.flops / hw.peak_flops if hw.peak_flops else 0.0
     scaled.hbm_s = scaled.hbm_traffic_bytes / hw.hbm_bw if hw.hbm_bw else 0.0
-    scaled.ici_s = max(
-        (b / hw.ici_bw for b in scaled.ici_bytes.values()), default=0.0
-    ) if hw.ici_bw else 0.0
-    scaled.est_step_s = max(scaled.compute_s, scaled.hbm_s, scaled.ici_s)
+    _reprice_links(scaled)
     return scaled
 
 
@@ -312,6 +356,7 @@ def plan_for_context(ctx) -> Plan:
         invar_groups=ctx.invar_groups,
         streams=ctx.streams,
         hardware=hw,
+        link_kinds=getattr(ctx, "link_kinds", None),
         source=ctx.source,
     )
     ctx._plan = plan
@@ -337,6 +382,7 @@ def plan_engine(engine, source: Optional[str] = None,
         invar_groups=meta.get("invar_groups", {}),
         streams=streams,
         hardware=hardware,
+        link_kinds=getattr(engine.topology, "link_kinds", None),
         source=source or f"engine[{type(engine).__name__}]",
     )
 

@@ -784,4 +784,30 @@ def config_parity_pairs(config, model) -> List[FormPair]:
                 "implicit_collectives", "codec",
             }),
         ))
+    if zero.get("hierarchical_wire"):
+        hier_raw = copy.deepcopy(raw)
+        flat_raw = copy.deepcopy(raw)
+        flat_raw["zero_optimization"] = dict(
+            flat_raw.get("zero_optimization") or {}, hierarchical_wire=False
+        )
+        pairs.append(FormPair(
+            name="train/grad-rs-2hop-vs-flat",
+            contract=(
+                "the two-hop intra-then-inter grad reduce-scatter carries "
+                "the same reduction structure as the flat single-ring RS "
+                "over the joint data axes (tests/test_wires.py; codec "
+                "forms within the property-tested bound)"
+            ),
+            form_a="2hop",
+            form_b="flat",
+            trace_a=_train_trace_thunk(hier_raw, model),
+            trace_b=_train_trace_thunk(flat_raw, model),
+            rewrites=frozenset({
+                "addressing", "chunking", "collective_decomposition",
+                "implicit_collectives", "codec",
+            }),
+            note="on a hybrid mesh the 2-hop form keeps the DCN hop to "
+                 "1/intra-size of the payload; R12 flags the flat form "
+                 "when a data axis is DCN-tagged",
+        ))
     return pairs

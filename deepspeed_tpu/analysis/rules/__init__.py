@@ -70,3 +70,5 @@ from . import overlap_budget  # noqa: E402,F401  (R8)
 from . import rng  # noqa: E402,F401  (R9)
 from . import reduction_order  # noqa: E402,F401  (R10)
 from . import trace_stability  # noqa: E402,F401  (R11)
+from . import dcn_collective  # noqa: E402,F401  (R12)
+from . import dcn_overlap  # noqa: E402,F401  (R13)

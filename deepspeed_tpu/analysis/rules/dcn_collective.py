@@ -1,0 +1,137 @@
+"""R12 — dcn-flat-collective: no flat ring across the slow inter-pod fabric.
+
+On a hybrid DCN×ICI mesh (``MeshTopology.hybrid``, ctx.link_kinds) a
+collective whose hop set spans BOTH link classes is the flat form ZeRO++
+(arXiv:2306.10209) exists to kill: a joint ring over ``("dp", "fsdp")``
+synchronizes every hop, so the whole full-width payload crawls at DCN
+bandwidth even though only the 1/n_i inter-group slice had to. The
+hierarchical 2-hop decomposition (``zero_optimization.hierarchical_wire``
+→ ``wires.rs_wire_hier_local`` / ``ag_wire_hier_local``) is statically
+distinguishable: it runs one single-axis collective per level — full
+width over the ICI axis, a shrunk (and codec-compressed) payload over
+the DCN axis — and stays clean here.
+
+Two flagged shapes:
+
+- a named collective (psum / all_gather / psum_scatter / all_to_all /
+  pbroadcast / pmin / pmax) whose bound axis set mixes a DCN-tagged axis
+  with an ICI axis — the joint flat ring;
+- a ``ppermute`` FULL RING over a DCN-tagged axis — a decomposed
+  ring-exchange (the TP-overlap / ring-flash pattern) streams n−1
+  full-width hops across the slow fabric; chains (pipeline neighbor
+  hops) are point-to-point and stay clean, as does a single-axis
+  reduction over DCN (that IS the 2-hop form's inter hop).
+
+Both carry a payload materiality floor (``_MIN_FLAT_BYTES``): a scalar
+loss psum or a layer-norm grad reduction over the joint data axes is
+latency-bound — decomposing it buys no bandwidth and costs a hop of
+latency — so only operands from ~a wire bucket upward flag.
+
+Silent without ``link_kinds`` DCN tags — flat meshes never see R12.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..base import ERROR, Finding, LintContext
+from ..trace import as_jaxpr, collective_axes, eqn_subjaxprs, shard_map_manual_axes
+from . import register_rule
+from .topology import check_permutation
+
+_FLAT_COLLECTIVES = {
+    "psum", "pmin", "pmax", "all_gather", "all_to_all", "psum_scatter",
+    "pbroadcast",
+}
+
+#: below this the ring is latency-bound: the joint flat form costs one
+#: synchronized ring, the 2-hop form costs two rings — for a scalar or a
+#: layer-norm-sized reduction the decomposition is strictly worse
+_MIN_FLAT_BYTES = 64 * 1024
+
+
+def _operand_bytes(eqn) -> int:
+    out = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        size = getattr(aval, "size", None)
+        if size is not None:
+            out = max(out, int(size) * aval.dtype.itemsize)
+    return out
+
+
+def is_full_ring(perm, axis_size: int) -> bool:
+    """True when ``perm`` is one well-formed cycle covering the whole
+    axis — the shape whose every hop crosses the axis's links."""
+    pairs = [tuple(p) for p in (perm or ())]
+    if len(pairs) != axis_size or axis_size < 2:
+        return False
+    if check_permutation(pairs, axis_size):
+        return False
+    # well-formed + one edge per member == the single full ring
+    return {s for s, _ in pairs} == set(range(axis_size))
+
+
+def _walk(jaxpr, axis_env: Dict[str, int], path: str, ctx: LintContext,
+          findings: List[Finding]) -> None:
+    kinds = ctx.link_kinds
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_path = f"{path}/{name}"
+        if name == "shard_map":
+            _walk(as_jaxpr(eqn.params["jaxpr"]),
+                  {**axis_env, **shard_map_manual_axes(eqn)},
+                  sub_path, ctx, findings)
+            continue
+        if name in _FLAT_COLLECTIVES:
+            live = [a for a in collective_axes(eqn)
+                    if axis_env.get(a, 1) > 1]
+            dcn = [a for a in live if kinds.get(a) == "dcn"]
+            ici = [a for a in live if kinds.get(a) != "dcn"]
+            if dcn and ici and _operand_bytes(eqn) >= _MIN_FLAT_BYTES:
+                findings.append(Finding(
+                    rule="R12",
+                    severity=ERROR,
+                    message=(
+                        f"{name} runs one flat ring jointly over DCN axis"
+                        f"{'es' if len(dcn) > 1 else ''} {dcn} and ICI "
+                        f"ax{'es' if len(ici) > 1 else 'is'} {ici} — every "
+                        "hop synchronizes on the slow inter-pod fabric, so "
+                        "the full-width payload moves at DCN bandwidth; "
+                        "decompose per level (hierarchical_wire over the "
+                        f"factored ({', '.join(dcn + ici)}) pair: full "
+                        "width intra-pod, the shrunk slice inter-pod)"
+                    ),
+                    where=sub_path,
+                ))
+        if name == "ppermute":
+            for a in collective_axes(eqn):
+                size = axis_env.get(a, 1)
+                if kinds.get(a) == "dcn" and is_full_ring(
+                    eqn.params.get("perm"), size
+                ) and _operand_bytes(eqn) >= _MIN_FLAT_BYTES:
+                    findings.append(Finding(
+                        rule="R12",
+                        severity=ERROR,
+                        message=(
+                            f"ppermute full ring over DCN-tagged axis "
+                            f"{a!r} (size {size}) — a decomposed ring "
+                            f"exchange streams {size - 1} full-width hops "
+                            "across the inter-pod fabric; keep ring "
+                            "decompositions on ICI axes and move the DCN "
+                            "slice once (hierarchical 2-hop form)"
+                        ),
+                        where=sub_path,
+                    ))
+        for _k, sub in eqn_subjaxprs(eqn):
+            _walk(sub, axis_env, sub_path, ctx, findings)
+
+
+@register_rule("R12", "dcn-flat-collective")
+def dcn_flat_collective(ctx: LintContext) -> List[Finding]:
+    kinds = ctx.link_kinds or {}
+    if not any(k == "dcn" for k in kinds.values()):
+        return []
+    findings: List[Finding] = []
+    _walk(ctx.jaxpr, {}, "", ctx, findings)
+    return findings

@@ -1,0 +1,102 @@
+"""R13 — dcn-overlap-budget: overlap claims must hold at DCN bandwidth.
+
+R8 prices every declared-overlapped stream against the step's compute
+window at its link's bandwidth — but it knows one wire speed. On a
+hybrid mesh (ctx.link_kinds) a stream whose collective traverses a
+DCN-tagged axis moves those bytes at ``hardware.dcn_bw``, an order of
+magnitude under ICI: an overlap claim that only fits at ICI bandwidth
+is a fiction the first multi-pod run exposes as a stalled step.
+
+Evidence, per declared-overlapped mesh stream (``kind != offload/hbm``,
+``engine.analytic_streams()``):
+
+- ``axes``: the mesh axes its collective runs over (the engine declares
+  them; streams without axes cannot be classified and stay R8-only);
+- hierarchical wire streams additionally carry
+  ``inter_bytes_per_step`` — only the shrunk inter-group hop rides DCN,
+  which is exactly how the 2-hop form earns its clean bill;
+- everything else crossing a DCN axis moves its FULL payload there (the
+  flat ring synchronizes on the slowest link — R12's pricing corollary).
+
+The DCN-priced seconds must fit the same roofline window R8 uses
+(max of the MXU and HBM terms), with the same 10 ms materiality floor
+on the exposed tail. Silent without DCN tags or declared streams.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..base import ERROR, Finding, LintContext
+from . import register_rule
+from .overlap_budget import _MIN_EXPOSED_S
+
+_GIB = float(1 << 30)
+
+
+def dcn_stream_bytes(stream, link_kinds) -> float:
+    """The per-step bytes of one analytic stream that cross a DCN-tagged
+    axis; 0.0 when the stream is unclassifiable or stays on ICI."""
+    if not stream or stream.get("kind") in ("offload", "hbm"):
+        return 0.0
+    axes = tuple(stream.get("axes") or ())
+    if not any(link_kinds.get(a) == "dcn" for a in axes):
+        return 0.0
+    if stream.get("hierarchical"):
+        return float(stream.get("inter_bytes_per_step", 0.0))
+    return float(
+        stream.get("per_device_bytes_per_step")
+        or stream.get("bytes_per_step", 0.0)
+    )
+
+
+@register_rule("R13", "dcn-overlap-budget")
+def dcn_overlap_budget(ctx: LintContext) -> List[Finding]:
+    kinds = ctx.link_kinds or {}
+    if not any(k == "dcn" for k in kinds.values()):
+        return []
+    streams = {
+        k: s for k, s in (ctx.streams or {}).items()
+        if s and s.get("overlapped")
+    }
+    if not streams:
+        return []
+    from ..cost import plan_for_context
+
+    plan = plan_for_context(ctx)
+    hw = plan.hardware
+    dcn_bw = float(getattr(hw, "dcn_bw", 0.0) or 0.0)
+    if dcn_bw <= 0:
+        return []
+    findings: List[Finding] = []
+    for name, s in streams.items():
+        nbytes = dcn_stream_bytes(s, kinds)
+        if nbytes <= 0:
+            continue
+        stream_s = nbytes / dcn_bw
+        window_s = max(plan.compute_s, plan.hbm_s)
+        if stream_s <= window_s or stream_s - window_s < _MIN_EXPOSED_S:
+            continue
+        ici_s = nbytes / hw.ici_bw if hw.ici_bw else 0.0
+        fits_at_ici = ici_s <= window_s
+        dcn_axes = [a for a in (s.get("axes") or ())
+                    if kinds.get(a) == "dcn"]
+        findings.append(Finding(
+            rule="R13",
+            severity=ERROR,
+            message=(
+                f"stream '{name}' is declared overlapped but "
+                f"{nbytes / _GIB:.2f} GiB/step of it crosses DCN ax"
+                f"{'es' if len(dcn_axes) > 1 else 'is'} {dcn_axes} at "
+                f"{dcn_bw / 1e9:.2f} GB/s — {stream_s:.4f}s against the "
+                f"{window_s:.4f}s compute window (MXU {plan.compute_s:.4f}s,"
+                f" HBM {plan.hbm_s:.4f}s)"
+                + ("; the claim only holds at ICI bandwidth "
+                   f"({ici_s:.4f}s) — the fabric under it is slower"
+                   if fits_at_ici else "")
+                + " — shrink the DCN hop (hierarchical 2-hop + wire codec) "
+                  "or stop declaring the stream hidden"
+            ),
+            where="<plan>",
+        ))
+    return findings

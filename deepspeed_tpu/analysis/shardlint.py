@@ -9,10 +9,11 @@ Three entry points, all CPU-cheap (abstract evaluation only):
   audits the jaxpr alone cannot express.
 - :func:`lint_config` — ds_config (+ model) → abstract engine → lint.
 
-The registry is R1–R11 (docs/shardlint.md); R9 (rng-discipline) and R10
+The registry is R1–R13 (docs/shardlint.md); R9 (rng-discipline) and R10
 (reduction-order) run on every program, R11 (trace-stability) arms when
 the trace driver supplies the step's traced-argument manifest — both
-entry points here do.
+entry points here do — and R12/R13 (DCN rules) arm when the topology
+carries DCN-tagged link metadata (hybrid meshes).
 """
 
 from __future__ import annotations
@@ -39,6 +40,7 @@ def lint_jaxpr(
     hbm_budget_bytes: Optional[float] = None,
     streams: Optional[Dict[str, Any]] = None,
     hardware=None,
+    link_kinds: Optional[Dict[str, str]] = None,
     donated_invars: Sequence[int] = (),
     invar_groups: Optional[Dict[str, Any]] = None,
     claims_keyfree: bool = False,
@@ -55,6 +57,7 @@ def lint_jaxpr(
         hbm_budget_bytes=hbm_budget_bytes,
         streams=dict(streams or {}),
         hardware=hardware,
+        link_kinds=dict(link_kinds or {}),
         donated_invars=tuple(donated_invars),
         invar_groups=dict(invar_groups or {}),
         claims_keyfree=claims_keyfree,
@@ -304,6 +307,7 @@ def lint_engine(engine, only: Optional[Sequence[str]] = None,
         hbm_budget_bytes=hbm_budget_bytes,
         streams=streams,
         hardware=hardware,
+        link_kinds=dict(getattr(engine.topology, "link_kinds", None) or {}),
         donated_invars=meta["donated_invars"],
         invar_groups=meta["invar_groups"],
         # R11: the train step must consume its per-step batch — a dead
@@ -333,7 +337,7 @@ def lint_serving_config(config, model=None, topology=None,
     """Lint a SERVING config: trace the continuous-batching engine's one
     jitted slot step abstractly (serving.trace_serving_step — params and
     the KV arena are ShapeDtypeStructs with real shardings) and run the
-    same R1–R11 registry over it (R11 armed by the
+    same R1–R13 registry over it (R11 armed by the
     trace's traced-args manifest). The declared analytic streams (the
     per-step KV-arena traffic) feed the planner and rule R8 exactly like
     the training engines' streams."""
@@ -379,6 +383,7 @@ def lint_serving_config(config, model=None, topology=None,
         hbm_budget_bytes=hbm_budget_bytes,
         streams=streams,
         hardware=hardware,
+        link_kinds=dict(getattr(topology, "link_kinds", None) or {}),
         required_traced=meta.get("required_traced", ()),
         traced_manifest=meta.get("traced_manifest", {}),
     )

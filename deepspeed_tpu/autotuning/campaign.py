@@ -59,6 +59,8 @@ AXIS_KNOBS: Dict[str, Tuple[str, str]] = {
                   "train/wire-codec-vs-full-width"),
     "param_wire": ("zero_optimization.param_wire",
                    "train/wire-codec-vs-full-width"),
+    "hier_wire": ("zero_optimization.hierarchical_wire",
+                  "train/grad-rs-2hop-vs-flat"),
 }
 #: serving-side spelling of the moe_a2a axis (token_budget candidates)
 SERVE_A2A_KNOB = ("serving.moe_a2a", "serving/moe-a2a-stock-vs-chunked")
@@ -88,15 +90,21 @@ class _TopoSizes:
     from each candidate config) — so derive the same sizes here without
     touching the global mesh."""
 
-    def __init__(self, sizes: Dict[str, int], world_size: int):
+    def __init__(self, sizes: Dict[str, int], world_size: int,
+                 link_kinds: Optional[Dict[str, str]] = None):
         self.sizes = sizes
         self.world_size = world_size
+        self.link_kinds = dict(link_kinds or {})
 
 
 def config_topology(cfg) -> _TopoSizes:
     """The mesh ``initialize()`` would build for this config (the same
-    fsdp/pp/ep/sp/tp derivation), resolved over the visible devices.
-    ``cfg`` is a DeepSpeedConfig or a raw ds_config dict."""
+    fsdp/pp/ep/sp/tp derivation, plus the topology section's DCN dp
+    factorization), resolved over the visible devices. ``cfg`` is a
+    DeepSpeedConfig or a raw ds_config dict. The link kinds ride along
+    so :func:`analysis.cost.topology_key` spells the hybrid
+    factorization ("dp2dcnxfsdp2x...") — a flat dp=8 row and a dp=4x2
+    hybrid row must never conflate."""
     import jax
 
     from ..comm.topology import ParallelDims
@@ -110,13 +118,16 @@ def config_topology(cfg) -> _TopoSizes:
         fsdp = ds.zero_config.zero_hpz_partition_size
     elif ds.zero_config.mics_shard_size > 0:
         fsdp = ds.zero_config.mics_shard_size
+    dcn_dp = int(getattr(ds.topology, "dcn_dp", 0) or 0)
     dims = ParallelDims(
+        dp=dcn_dp if dcn_dp > 1 else 0,
         fsdp=fsdp, pp=ds.pipeline.stages,
         ep=ds.moe.ep_size if ds.moe.enabled else 1,
         sp=ds.sequence_parallel.sp_size, tp=ds.tensor_parallel.tp_size,
     )
     world = max(len(jax.devices()), 1)
-    return _TopoSizes(dims.resolve(world), world)
+    kinds = {a: "dcn" for a in getattr(ds.topology, "dcn_axes", tuple)()}
+    return _TopoSizes(dims.resolve(world), world, kinds)
 
 
 def candidate_knobs(cand) -> Dict[str, Any]:

@@ -35,7 +35,11 @@ Candidate axes:
   exchange, ISSUE 14) when the config serves a MoE model expert-parallel
   — static-only, the PR-7 serving-measurement refusal stands;
 - mesh shape (dp×tp factorizations) for capacity dryruns — CLI-only,
-  ``tools/autoplan.py --dryrun-mesh``;
+  ``tools/autoplan.py --dryrun-mesh``; a ``dcn_dp*fsdp x tp`` spelling
+  enumerates hybrid dp-factorizations (ISSUE 17), each priced through
+  per-link bandwidths (``Plan.dcn_s``) with the 2-hop-vs-flat grad RS
+  (``zero_optimization.hierarchical_wire`` — the existing knob, no new
+  one) as a search axis on those rungs;
 - flash tiles are enumerable but *plan-invariant* (the traced program
   does not change with kernel block shapes), so the search carries them
   only when asked and the measured tile sweep stays the tuner's
@@ -84,8 +88,10 @@ class Candidate:
     z3_prefetch: Optional[bool] = None   # stage-3 layer prefetch on/off
     grad_wire: Optional[str] = None      # grad RS codec (stage >= 1 rungs)
     param_wire: Optional[str] = None     # stage-3 param gather codec
+    hier_wire: Optional[bool] = None     # 2-hop vs flat grad RS (hybrid mesh)
     token_budget: Optional[int] = None
-    mesh: Optional[Tuple[int, int]] = None  # (dp, tp)
+    # (dp, tp) flat, or (dcn_dp, fsdp, tp) for a hybrid dp-factorization
+    mesh: Optional[Tuple[int, ...]] = None
 
     @property
     def zero_dict(self) -> Optional[Dict[str, Any]]:
@@ -101,7 +107,8 @@ class Candidate:
         scale batch-linearly."""
         return (self.zero, self.remat, self.flash_blocks, self.tp_overlap,
                 self.moe_a2a, self.z3_prefetch, self.grad_wire,
-                self.param_wire, self.token_budget, self.mesh)
+                self.param_wire, self.hier_wire, self.token_budget,
+                self.mesh)
 
     def label(self) -> str:
         z = self.zero_dict
@@ -122,12 +129,19 @@ class Candidate:
             parts.append(f"gw-{self.grad_wire}")
         if self.param_wire is not None and self.param_wire != "fp32":
             parts.append(f"pw-{self.param_wire}")
+        if self.hier_wire is not None:
+            parts.append("rs2hop" if self.hier_wire else "rsflat")
         if self.token_budget is not None:
             parts = [f"serve-tb{self.token_budget}"]
             if self.moe_a2a is not None:
                 parts.append("a2achunk" if self.moe_a2a else "a2astock")
         if self.mesh is not None:
-            parts.append(f"dp{self.mesh[0]}xtp{self.mesh[1]}")
+            if len(self.mesh) == 3:
+                parts.append(
+                    f"dp{self.mesh[0]}dcnxfsdp{self.mesh[1]}xtp{self.mesh[2]}"
+                )
+            else:
+                parts.append(f"dp{self.mesh[0]}xtp{self.mesh[1]}")
         if any(self.flash_blocks):
             parts.append("x".join(str(b) for b in self.flash_blocks))
         return "/".join(parts)
@@ -356,8 +370,23 @@ class PlannerSearch:
             list(self.mesh_shapes) if self.mesh_shapes else [None]
         )
         base_stage = int(ds.zero_config.stage)
+        # the hybrid dp-factorization axis (ISSUE 17): a 3-tuple mesh
+        # (dcn_dp, fsdp, tp) or a session topology whose dp axis is
+        # DCN-tagged makes the 2-hop-vs-flat grad RS an enumerable form —
+        # the existing zero_optimization.hierarchical_wire bool IS the
+        # knob, the search just flips it and lets per-link pricing
+        # (dcn_s in the roofline max) rank the factorizations
+        topo_kinds = dict(getattr(self.topology, "link_kinds", None) or {})
+        topo_hybrid = (
+            "dcn" in topo_kinds.values()
+            and self.topology.sizes["dp"] > 1
+            and self.topology.sizes["fsdp"] > 1
+        ) if self.topology is not None else False
         out = []
         for mesh in meshes:
+            mesh_hybrid = (mesh is not None and len(mesh) == 3
+                           and mesh[0] > 1 and mesh[1] > 1)
+            hybrid = mesh_hybrid or (mesh is None and topo_hybrid)
             for zero in self._zero_axis():
                 # stage-3 layer prefetch: an axis only on stage-3 rungs
                 # (the knob is a no-op elsewhere — enumerating it would
@@ -398,6 +427,14 @@ class PlannerSearch:
                     if int(stage) == 3 and len(wires) > 1 and data_live
                     else [None]
                 )
+                # 2-hop vs flat grad RS: an axis only on hybrid
+                # factored meshes with a wired reduction to decompose
+                # (stage >= 1, same no-op exclusions as the grad axis)
+                hw_axis: List[Optional[bool]] = (
+                    [False, True]
+                    if hybrid and int(stage) >= 1 and gw_ok
+                    else [None]
+                )
                 for pol in (self.remat_policies
                             if self.remat_policies is not None
                             else REMAT_POLICIES):
@@ -407,20 +444,23 @@ class PlannerSearch:
                                 for z3 in z3_axis:
                                     for gw in gw_axis:
                                         for pw in pw_axis:
-                                            for blocks in tiles:
-                                                out.append(Candidate(
-                                                    zero=zero, remat=pol,
-                                                    micro=mb,
-                                                    flash_blocks=tuple(
-                                                        blocks
-                                                    ),
-                                                    tp_overlap=ov,
-                                                    moe_a2a=a2a,
-                                                    z3_prefetch=z3,
-                                                    grad_wire=gw,
-                                                    param_wire=pw,
-                                                    mesh=mesh,
-                                                ))
+                                            for hw in hw_axis:
+                                                for blocks in tiles:
+                                                    out.append(Candidate(
+                                                        zero=zero,
+                                                        remat=pol,
+                                                        micro=mb,
+                                                        flash_blocks=tuple(
+                                                            blocks
+                                                        ),
+                                                        tp_overlap=ov,
+                                                        moe_a2a=a2a,
+                                                        z3_prefetch=z3,
+                                                        grad_wire=gw,
+                                                        param_wire=pw,
+                                                        hier_wire=hw,
+                                                        mesh=mesh,
+                                                    ))
         return out
 
     # ----------------------------------------------------------------- plan
@@ -469,6 +509,17 @@ class PlannerSearch:
             zo = dict(cfg.get("zero_optimization") or {})
             zo["param_wire"] = cand.param_wire
             cfg["zero_optimization"] = zo
+        if cand.hier_wire is not None:
+            zo = dict(cfg.get("zero_optimization") or {})
+            zo["hierarchical_wire"] = bool(cand.hier_wire)
+            cfg["zero_optimization"] = zo
+        if cand.mesh is not None and len(cand.mesh) == 3:
+            # the config stays self-describing: the topology section
+            # names the DCN factorization so the campaign's topology_key
+            # cannot conflate flat dp=8 with dp=4x2 rows
+            cfg["topology"] = dict(
+                cfg.get("topology") or {}, dcn_dp=int(cand.mesh[0])
+            )
         if cand.token_budget is not None:
             sv = dict(cfg.get("serving") or {})
             sv["token_budget"] = int(cand.token_budget)
@@ -480,6 +531,11 @@ class PlannerSearch:
             return self.topology
         from ..comm.topology import MeshTopology, ParallelDims
 
+        if len(cand.mesh) == 3:
+            dcn_dp, fsdp, tp = cand.mesh
+            return MeshTopology.hybrid(
+                dims=ParallelDims(dp=dcn_dp, fsdp=fsdp, tp=tp)
+            )
         dp, tp = cand.mesh
         return MeshTopology(dims=ParallelDims(dp=dp, tp=tp))
 
@@ -546,8 +602,11 @@ class PlannerSearch:
     def search(self) -> SearchResult:
         result = SearchResult(budget_bytes=self.budget_bytes)
         memo: Dict[Tuple, PlannedCandidate] = {}  # group → last pruned trace
+        # ordering only needs each group contiguous with micro ascending
+        # (the memoized-scaling invariant); repr gives a total order over
+        # group keys that mix None with bools/tuples across mesh rungs
         for cand in sorted(self.candidates(),
-                           key=lambda c: (c.group_key(), c.micro)):
+                           key=lambda c: (repr(c.group_key()), c.micro)):
             prior = memo.get(cand.group_key())
             if (prior is not None and prior.pruned and prior.plan is not None
                     and cand.micro > prior.cand.micro):

@@ -54,6 +54,7 @@ def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    dcn_axes=None,
     **_ignored,
 ) -> MeshTopology:
     """Parity: deepspeed.init_distributed().
@@ -61,6 +62,9 @@ def init_distributed(
     Multi-host: if coordinator env/args are present, calls
     ``jax.distributed.initialize`` (the reference's torch.distributed init).
     Then builds the global mesh topology over all visible devices.
+    ``dcn_axes`` (e.g. ``("dp",)``) builds a two-level hybrid mesh
+    (:meth:`MeshTopology.hybrid`) whose named axes carry link metadata —
+    the static layer prices and lints inter-pod traffic off it.
     """
     global _TOPOLOGY, _INITIALIZED
     if dist_backend not in ("xla", "tpu", "auto"):
@@ -88,7 +92,12 @@ def init_distributed(
     if topology is not None:
         _TOPOLOGY = topology
     elif dims is not None or _TOPOLOGY is None:
-        _TOPOLOGY = MeshTopology(dims or ParallelDims())
+        if dcn_axes:
+            _TOPOLOGY = MeshTopology.hybrid(
+                dims or ParallelDims(), dcn_axes=tuple(dcn_axes)
+            )
+        else:
+            _TOPOLOGY = MeshTopology(dims or ParallelDims())
     _INITIALIZED = True
     log_dist(f"init_distributed: {_TOPOLOGY}")
     return _TOPOLOGY

@@ -24,6 +24,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 # Canonical axis order, outermost → innermost.
 AXIS_ORDER: Tuple[str, ...] = ("dp", "fsdp", "pp", "ep", "sp", "tp")
 
+# Per-axis link classes: "ici" (intra-pod, fast) or "dcn" (inter-pod, slow).
+LINK_KINDS: Tuple[str, ...] = ("ici", "dcn")
+
 # DeepSpeed name → ours (reference topology axes are pipe/data/model).
 AXIS_ALIASES = {"data": "dp", "pipe": "pp", "model": "tp", "expert": "ep", "sequence": "sp"}
 
@@ -68,6 +71,7 @@ class MeshTopology:
         self,
         dims: Optional[ParallelDims] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        link_kinds: Optional[Dict[str, str]] = None,
         **axis_sizes: int,
     ):
         if dims is None:
@@ -76,10 +80,85 @@ class MeshTopology:
         self.world_size = len(self.devices)
         self.sizes = dims.resolve(self.world_size)
         self.axes: Tuple[str, ...] = tuple(ax for ax in AXIS_ORDER)
+        self.link_kinds: Dict[str, str] = {
+            ax: (link_kinds or {}).get(_canon(ax), "ici") for ax in self.axes
+        }
+        for ax, kind in self.link_kinds.items():
+            if kind not in LINK_KINDS:
+                raise ValueError(
+                    f"link_kinds[{ax!r}] must be one of {LINK_KINDS}, got {kind!r}"
+                )
         grid = np.asarray(self.devices, dtype=object).reshape(
             [self.sizes[ax] for ax in self.axes]
         )
         self.mesh = Mesh(grid, self.axes)
+
+    @classmethod
+    def hybrid(
+        cls,
+        dims: Optional[ParallelDims] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        *,
+        dcn_axes: Sequence[str] = ("dp",),
+        **axis_sizes: int,
+    ) -> "MeshTopology":
+        """Two-level DCN×ICI mesh (``mesh_utils.create_hybrid_device_mesh``).
+
+        The DCN-tagged axes are the slice dimensions: each coordinate along
+        them selects one ICI-connected pod, so they must be *outermost*
+        (slowest-varying over the device list) — collectives along them ride
+        the slow inter-pod fabric, everything else stays on ICI. On a real
+        multi-slice TPU backend the grid comes from
+        ``create_hybrid_device_mesh`` (slices discovered via
+        ``device.slice_index``); everywhere else — the tier-1 CPU box — the
+        row-major reshape over the flat device list is exactly the emulated
+        layout (DCN axes lead ``AXIS_ORDER``), so hybrid shapes build and
+        trace without TPU hardware.
+        """
+        if dims is None:
+            dims = ParallelDims(**{_canon(k): v for k, v in axis_sizes.items()})
+        devs = list(devices if devices is not None else jax.devices())
+        dcn = tuple(_canon(a) for a in dcn_axes)
+        for a in dcn:
+            if a not in AXIS_ORDER:
+                raise ValueError(f"unknown DCN axis {a!r}; have {AXIS_ORDER}")
+        sizes = dims.resolve(len(devs))
+        live_ici = [
+            ax for ax in AXIS_ORDER if sizes[ax] > 1 and ax not in dcn
+        ]
+        for a in dcn:
+            inner = [i for i in live_ici if AXIS_ORDER.index(i) < AXIS_ORDER.index(a)]
+            if inner:
+                raise ValueError(
+                    f"DCN axis {a!r} must be outermost (slowest-varying); "
+                    f"ICI axes {inner} precede it in {AXIS_ORDER}"
+                )
+        if devs and getattr(devs[0], "platform", "cpu") == "tpu" and any(
+            getattr(d, "slice_index", 0) for d in devs
+        ):
+            # real multi-slice backend: let jax group devices by slice
+            from jax.experimental import mesh_utils
+
+            ici_shape = [1 if ax in dcn else sizes[ax] for ax in AXIS_ORDER]
+            dcn_shape = [sizes[ax] if ax in dcn else 1 for ax in AXIS_ORDER]
+            grid = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devs
+            )
+            devs = list(grid.reshape(-1))
+        kinds = {ax: ("dcn" if ax in dcn else "ici") for ax in AXIS_ORDER}
+        return cls(dims, devices=devs, link_kinds=kinds)
+
+    @property
+    def dcn_axes(self) -> Tuple[str, ...]:
+        """Live axes whose links ride the slow inter-pod fabric."""
+        return tuple(
+            ax for ax in self.axes
+            if self.sizes[ax] > 1 and self.link_kinds.get(ax) == "dcn"
+        )
+
+    @property
+    def is_hybrid(self) -> bool:
+        return bool(self.dcn_axes)
 
     # -- DeepSpeed ProcessTopology parity -------------------------------------
     def get_dim(self, axis: str) -> int:
@@ -151,7 +230,12 @@ class MeshTopology:
         return PartitionSpec(batch_axes, seq_axes)
 
     def __repr__(self) -> str:
-        dims = "x".join(f"{ax}={self.sizes[ax]}" for ax in self.axes if self.sizes[ax] > 1)
+        dims = "x".join(
+            f"{ax}={self.sizes[ax]}"
+            + ("[dcn]" if self.link_kinds.get(ax) == "dcn" else "")
+            for ax in self.axes
+            if self.sizes[ax] > 1
+        )
         return f"MeshTopology({dims or 'single-device'}, world={self.world_size})"
 
 

@@ -278,6 +278,32 @@ class PipelineConfig:
 
 
 @dataclass
+class TopologyConfig:
+    """"topology" section — the physical fabric under the mesh.
+
+    ``dcn_dp``: the data-parallel axis rides the inter-pod DCN fabric
+    with this many pods (0/1 = flat single-pod ICI mesh). When > 1 the
+    engine builds a two-level hybrid mesh (``MeshTopology.hybrid``: the
+    DCN-tagged dp axis outermost, ICI axes inside), the cost planner
+    prices dp-crossing collectives at ``hardware.dcn_bw`` and rules
+    R12/R13 arm. This describes the fabric, not a tuning choice: the
+    2-hop hierarchical split is the planner's job to pick
+    (docs/memory_planner.md "Per-link pricing").
+    """
+
+    dcn_dp: int = 0
+
+    def validate(self) -> None:
+        if self.dcn_dp < 0:
+            raise DeepSpeedConfigError(
+                f"topology.dcn_dp must be >= 0, got {self.dcn_dp}"
+            )
+
+    def dcn_axes(self) -> tuple:
+        return ("dp",) if self.dcn_dp > 1 else ()
+
+
+@dataclass
 class MoEOverlapA2AConfig:
     """"moe.overlap_a2a" — decomposed MoE all-to-all
     (parallel/a2a_overlap.py): the GSPMD dispatch/combine exchanges at the
@@ -993,6 +1019,7 @@ class DeepSpeedConfig:
         if "stages" not in pipe and "num_stages" in pipe:
             pipe["stages"] = pipe.pop("num_stages")
         self.pipeline = _parse_dc(PipelineConfig, pipe)
+        self.topology = _parse_dc(TopologyConfig, d.get("topology"))
         self.moe = _parse_dc(MoEConfig, d.get("moe"))
         tp = dict(d.get("tensor_parallel") or {})
         if "autotp_size" in tp and "tp_size" not in tp:
@@ -1138,6 +1165,7 @@ class DeepSpeedConfig:
             )
         self.activation_checkpointing.validate()
         self.sparse_attention.validate()
+        self.topology.validate()
         self.checkpoint.validate()
         self.steptrace.validate()
         self.healthwatch.validate()

@@ -126,6 +126,7 @@ def initialize(
                 "cannot place stage layers it doesn't know about"
             )
         topology = comm.init_distributed(dims=ParallelDims(
+            dp=cfg.topology.dcn_dp if cfg.topology.dcn_dp > 1 else 0,
             tp=_mpu_size("get_tensor_model_parallel_world_size",
                          "get_model_parallel_world_size"),
             pp=mpu_pp if mpu_pp > 1 else cfg.pipeline.stages,
@@ -135,7 +136,7 @@ def initialize(
                   if cfg.zero_config.zero_hpz_partition_size > 1
                   else (cfg.zero_config.mics_shard_size
                         if cfg.zero_config.mics_shard_size > 0 else 1)),
-        ))
+        ), dcn_axes=cfg.topology.dcn_axes())
     if topology is None:
         if comm.is_initialized():
             topology = comm.get_topology()
@@ -150,7 +151,11 @@ def initialize(
             elif cfg.zero_config.mics_shard_size > 0:
                 fsdp = cfg.zero_config.mics_shard_size
             topology = comm.init_distributed(
-                dims=ParallelDims(fsdp=fsdp, pp=pp, ep=ep, sp=sp, tp=tp)
+                dims=ParallelDims(
+                    dp=cfg.topology.dcn_dp if cfg.topology.dcn_dp > 1 else 0,
+                    fsdp=fsdp, pp=pp, ep=ep, sp=sp, tp=tp,
+                ),
+                dcn_axes=cfg.topology.dcn_axes(),
             )
     else:
         comm.set_topology(topology)
@@ -962,8 +967,15 @@ class TpuEngine:
         ``include_potential=True`` also prices streams the config
         declares but this mesh cannot pin (the CPU lint mesh has no
         memory kinds) — what the planner budgets; the comms logger only
-        ever records the actual (default) set."""
+        ever records the actual (default) set.
+
+        Every mesh stream carries ``axes``: the mesh axes its collective
+        runs over, so per-link pricing (hybrid DCN meshes, rule R13) can
+        tell which bytes cross the slow fabric."""
         streams = {}
+        data_axes = tuple(
+            a for a in ("dp", "fsdp") if self.topology.sizes[a] > 1
+        )
         off = self.offload_stream
         if off is None and include_potential:
             off = self._compute_offload_stream(assume_offload=True)
@@ -988,6 +1000,7 @@ class TpuEngine:
                 streams["tp_ring"] = {
                     **ring,
                     "kind": "ici",
+                    "axes": ("tp",),
                     # ring_wire_bytes_per_step is already per device
                     "bytes_per_step": ring["bytes_per_step"],
                     "per_device_bytes_per_step": ring["bytes_per_step"],
@@ -1006,6 +1019,7 @@ class TpuEngine:
             streams["moe_a2a"] = {
                 **a2a,
                 "kind": "ici",
+                "axes": ("ep",),
                 # moe_a2a_bytes_per_step is already per device
                 "bytes_per_step": a2a["bytes_per_step"],
                 "per_device_bytes_per_step": a2a["bytes_per_step"],
@@ -1018,6 +1032,7 @@ class TpuEngine:
             streams["zero3_prefetch"] = {
                 **z3,
                 "kind": "ici",
+                "axes": data_axes,
                 "bytes_per_step": z3["bytes_per_step"],
                 "per_device_bytes_per_step": z3["bytes_per_step"],
                 "overlapped": True,
@@ -1033,6 +1048,7 @@ class TpuEngine:
             streams["grad_wire"] = {
                 **gw,
                 "kind": "ici",
+                "axes": data_axes,
                 "bytes_per_step": gw["bytes_per_step"],
                 "per_device_bytes_per_step": gw["bytes_per_step"],
                 "overlapped": False,
@@ -1042,6 +1058,7 @@ class TpuEngine:
             streams["param_wire"] = {
                 **pw,
                 "kind": "ici",
+                "axes": data_axes,
                 "bytes_per_step": pw["bytes_per_step"],
                 "per_device_bytes_per_step": pw["bytes_per_step"],
                 "overlapped": False,

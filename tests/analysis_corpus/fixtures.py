@@ -45,6 +45,13 @@ trace-ready evidence of one statically-visible bug class:
   f32, the qgZ contract)
 - ``static_arg_per_tick``   R11: a slot step whose ``spec_len`` was
   baked as a python constant at trace time (the clean twin traces it)
+- ``dcn_flat_ring``         R12: the flat joint-(dp, fsdp) wire ring on
+  a hybrid mesh whose dp axis is DCN-tagged (the clean twin traces the
+  hierarchical 2-hop form of the same wire)
+- ``dcn_unbudgeted_stream`` R13: a declared-overlapped stream whose
+  payload only fits the compute window at ICI speed, not on the
+  DCN-tagged axis it crosses (the clean twin splits hierarchically and
+  declares the shrunk inter hop)
 
 Each has a ``*_clean`` twin proving the rules don't fire on the fixed
 form. All fixtures trace on the 8-device CPU mesh (no execution).
@@ -951,6 +958,99 @@ def static_arg_per_tick_clean():
     return closed, kw, "R11"
 
 
+# --------------------------------------------------------------------- R12
+# flat vs 2-hop grad reduce-scatter on a HYBRID mesh (ISSUE 17): the
+# hazard traces the real comm/wires.py FLAT form — one joint ring over
+# ("dp", "fsdp") — on a mesh whose dp axis is DCN-tagged, so every hop of
+# the full payload synchronizes on the slow inter-pod link; the clean
+# twin traces the SAME wire hierarchical (intra-fsdp ring on ICI, then
+# the 1/n_fsdp-sized inter hop over dp), the decomposition R12 names
+def _dcn_topo():
+    from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+
+    return MeshTopology.hybrid(dims=ParallelDims(dp=2, fsdp=4))
+
+
+def _dcn_ring(hierarchical: bool):
+    from deepspeed_tpu.comm.wires import reduce_scatter_wire
+
+    topo = _dcn_topo()
+
+    def prog(contribs):
+        return reduce_scatter_wire(
+            contribs, topo, ("dp", "fsdp"), "int8",
+            hierarchical=hierarchical,
+        )
+
+    # a wire-bucket-sized payload: past R12's latency-bound materiality
+    # floor, so the joint flat ring flags on bandwidth grounds
+    contribs = jax.ShapeDtypeStruct((8, 2048, 64), jnp.float32)
+    kw = {"mesh": topo.mesh, "link_kinds": topo.link_kinds}
+    return jax.make_jaxpr(prog)(contribs), kw
+
+
+def dcn_flat_ring():
+    closed, kw = _dcn_ring(hierarchical=False)
+    return closed, kw, "R12"
+
+
+def dcn_flat_ring_clean():
+    closed, kw = _dcn_ring(hierarchical=True)
+    return closed, kw, "R12"
+
+
+# --------------------------------------------------------------------- R13
+# overlap claims must hold at DCN bandwidth: the hazard declares an
+# overlapped grad-wire stream over a DCN-tagged dp axis whose payload
+# fits the compute window at ICI speed (R8 stays silent — its one wire
+# speed IS the ICI figure) but takes ~80x the window on the inter-pod
+# link; the clean twin is the hierarchical split of the same stream,
+# whose declared inter_bytes_per_step hop is all that rides DCN
+def _dcn_stream(hierarchical: bool):
+    from deepspeed_tpu.analysis.cost import HardwareModel
+
+    mesh = corpus_mesh()
+
+    def prog(x, w):
+        return jnp.einsum("bk,kn->bn", x, w).sum()
+
+    x = jax.ShapeDtypeStruct((64, 128), jnp.float32)
+    w = jax.ShapeDtypeStruct((128, 128), jnp.float32)
+    closed = jax.make_jaxpr(prog)(x, w)
+    # ~21 ms compute window; 16 MiB/step fits it at 1 GB/s ICI (~17 ms)
+    # but not at the 0.01 GB/s DCN share (~1.7 s)
+    stream = {
+        "kind": "ici",
+        "axes": ("dp",),
+        "bytes_per_step": 16 * (1 << 20),
+        "per_device_bytes_per_step": 16 * (1 << 20),
+        "overlapped": True,
+    }
+    if hierarchical:
+        stream["hierarchical"] = True
+        stream["inter_bytes_per_step"] = 64 * 1024
+    kw = {
+        "mesh": mesh,
+        "link_kinds": {"dp": "dcn"},
+        "streams": {"grad_wire": stream},
+        "hardware": HardwareModel(
+            gen="test", peak_flops=1e8, hbm_bytes=1 << 30, hbm_bw=1e9,
+            ici_bw=1e9, host_bw=1e9, dcn_bw=1e7,
+        ),
+    }
+    return closed, kw
+
+
+def dcn_unbudgeted_stream():
+    closed, kw = _dcn_stream(hierarchical=False)
+    return closed, kw, "R13"
+
+
+def dcn_unbudgeted_stream_clean():
+    closed, kw = _dcn_stream(hierarchical=True)
+    return closed, kw, "R13"
+
+
 HAZARDS = [
     stacked_dim0_drift,
     slot_cache_carry_drift,
@@ -974,6 +1074,8 @@ HAZARDS = [
     rng_key_reuse,
     reassoc_accum_drift,
     static_arg_per_tick,
+    dcn_flat_ring,
+    dcn_unbudgeted_stream,
 ]
 
 CLEAN_TWINS = [
@@ -999,4 +1101,6 @@ CLEAN_TWINS = [
     rng_key_reuse_clean,
     reassoc_accum_drift_clean,
     static_arg_per_tick_clean,
+    dcn_flat_ring_clean,
+    dcn_unbudgeted_stream_clean,
 ]

@@ -1,0 +1,188 @@
+"""Hybrid DCN×ICI meshes (ISSUE 17): per-link cost pricing, the R13
+stream classifier, the planner's knob-free 2-hop-vs-flat ranking, and
+the hybrid mesh spellings carried by autoplan and the campaign ledger.
+
+The R12/R13 fire/clean behavior itself rides the lint corpus
+(tests/analysis_corpus/fixtures.py: dcn_flat_ring / dcn_unbudgeted_stream
+and their clean twins) — here we pin the unit-level semantics the rules
+and the planner build on."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.analysis.cost.hardware import HardwareModel, topology_key
+from deepspeed_tpu.analysis.cost.planner import (
+    Plan,
+    _reprice_links,
+    scale_plan_micro,
+    split_link_bytes,
+)
+from deepspeed_tpu.analysis.rules.dcn_overlap import dcn_stream_bytes
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.models import gpt2
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hw(dcn_bw=1e8):
+    return HardwareModel(gen="test", peak_flops=1e12, hbm_bytes=16 << 30,
+                         hbm_bw=1e12, ici_bw=1e9, host_bw=1e10,
+                         dcn_bw=dcn_bw)
+
+
+# ------------------------------------------------------ per-link pricing
+def test_split_link_bytes_classifies_by_any_dcn_axis():
+    ici_bytes = {"fsdp": 4.0, "dp": 2.0, "dp+fsdp": 3.0, "?": 1.0}
+    ici, dcn = split_link_bytes(ici_bytes, {"dp": "dcn"})
+    assert ici == {"fsdp": 4.0, "?": 1.0}
+    # a ring touching ANY dcn axis is throttled end-to-end
+    assert dcn == {"dp": 2.0, "dp+fsdp": 3.0}
+    # no link metadata -> everything stays ICI (flat meshes)
+    ici, dcn = split_link_bytes(ici_bytes, {})
+    assert ici == ici_bytes and dcn == {}
+
+
+def test_plan_prices_dcn_rings_at_dcn_bw():
+    plan = Plan(source="t", hardware=_hw(dcn_bw=1e8), n_devices=8)
+    plan.ici_bytes = {"fsdp": 1e9, "dp": 5e8}
+    plan.link_kinds = {"dp": "dcn"}
+    _, plan.dcn_bytes = split_link_bytes(plan.ici_bytes, plan.link_kinds)
+    _reprice_links(plan)
+    assert plan.ici_s == pytest.approx(1.0)    # 1 GB over 1 GB/s ICI
+    assert plan.dcn_s == pytest.approx(5.0)    # 0.5 GB over 0.1 GB/s DCN
+    assert plan.est_step_s == pytest.approx(5.0)
+    # batch-linear scaling carries the dcn bucket and reprices it
+    scaled = scale_plan_micro(plan, 2.0)
+    assert scaled.dcn_bytes["dp"] == pytest.approx(1e9)
+    assert scaled.dcn_s == pytest.approx(10.0)
+    assert scaled.est_step_s == pytest.approx(10.0)
+    # the serialized spelling carries both buckets
+    d = plan.to_dict()
+    assert d["dcn_bytes"] == {"dp": round(5e8)}
+    assert d["dcn_s"] == pytest.approx(5.0)
+
+
+def test_plan_without_dcn_bw_never_prices_dcn():
+    plan = Plan(source="t", hardware=_hw(dcn_bw=0.0), n_devices=8)
+    plan.ici_bytes = {"dp": 5e8}
+    plan.link_kinds = {"dp": "dcn"}
+    _, plan.dcn_bytes = split_link_bytes(plan.ici_bytes, plan.link_kinds)
+    _reprice_links(plan)
+    assert plan.dcn_s == 0.0
+
+
+# --------------------------------------------------- R13 stream classifier
+def test_dcn_stream_bytes_classification():
+    kinds = {"dp": "dcn"}
+    base = {"kind": "ici", "axes": ("dp",), "bytes_per_step": 10.0}
+    # offload/hbm streams ride PCIe/HBM, never DCN
+    assert dcn_stream_bytes(dict(base, kind="offload"), kinds) == 0.0
+    assert dcn_stream_bytes(dict(base, kind="hbm"), kinds) == 0.0
+    # ICI-only axes stay R8's problem
+    assert dcn_stream_bytes(dict(base, axes=("fsdp",)), kinds) == 0.0
+    assert dcn_stream_bytes({}, kinds) == 0.0
+    # a flat stream crossing dp moves its full payload on DCN
+    assert dcn_stream_bytes(base, kinds) == 10.0
+    assert dcn_stream_bytes(
+        dict(base, per_device_bytes_per_step=7.0), kinds) == 7.0
+    # the hierarchical wire only ships the shrunk inter-group hop there
+    assert dcn_stream_bytes(
+        dict(base, hierarchical=True, inter_bytes_per_step=2.0), kinds
+    ) == 2.0
+
+
+# ------------------------------------------- planner: 2-hop beats flat
+def test_planner_ranks_2hop_above_flat_on_hybrid(devices8):
+    """On a hybrid mesh with dcn_bw ≪ ici_bw, per-link pricing alone —
+    no new knob — must rank the hierarchical 2-hop grad reduce-scatter
+    above the flat single-ring form."""
+    from deepspeed_tpu.autotuning import PlannerSearch
+
+    base = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2, "grad_wire": "int8"},
+        "autotuning": {"max_train_micro_batch_size_per_gpu": 1,
+                       "tune_zero": False},
+    }
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16,
+                 hidden_size=32, num_layers=2, num_heads=2)
+    search = PlannerSearch(model, base, None, top_k=1,
+                           mesh_shapes=[(2, 4, 1)],
+                           hardware=_hw(dcn_bw=1e6),
+                           wire_codecs=("int8",))
+    cands = search.candidates()
+    assert {c.hier_wire for c in cands} == {False, True}
+    two_hop = next(c for c in cands if c.hier_wire)
+    cfg = search._candidate_config(two_hop)
+    assert cfg["zero_optimization"]["hierarchical_wire"] is True
+    assert cfg["topology"]["dcn_dp"] == 2
+    assert "rs2hop" in two_hop.label() and "dcnx" in two_hop.label()
+
+    res = search.search()
+    ranked = [p for p in res.survivors if p.plan is not None]
+    assert ranked, res.explain()
+    best = res.survivors[0]
+    assert best.cand.hier_wire is True, res.explain()
+    # the flat twin at the same rung priced its full grad payload on DCN
+    flat = next(p for p in res.planned
+                if p.cand.hier_wire is False
+                and p.cand.group_key()[:3] == best.cand.group_key()[:3]
+                and p.cand.micro == best.cand.micro and p.plan is not None)
+    assert sum(flat.plan.dcn_bytes.values()) > sum(
+        best.plan.dcn_bytes.values())
+    assert flat.plan.est_step_s > best.plan.est_step_s
+
+
+# ------------------------------------------------- mesh spellings
+def test_autoplan_parse_meshes_hybrid_syntax():
+    spec = importlib.util.spec_from_file_location(
+        "autoplan", os.path.join(REPO, "tools", "autoplan.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.parse_meshes("8x1,4x2") == [(8, 1), (4, 2)]
+    assert mod.parse_meshes("2*4x1,2*2x2") == [(2, 4, 1), (2, 2, 2)]
+
+
+def test_topology_key_spells_hybrid_factorization(devices8):
+    flat = MeshTopology(ParallelDims(dp=2, fsdp=4))
+    hybrid = MeshTopology.hybrid(ParallelDims(dp=2, fsdp=4))
+    assert topology_key(flat) == "dp2xfsdp4"
+    assert topology_key(hybrid) == "dp2dcnxfsdp4"
+
+
+def test_campaign_config_topology_carries_dcn(devices8):
+    from deepspeed_tpu.autotuning.campaign import config_topology
+
+    cfg = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "topology": {"dcn_dp": 2},
+        "zero_optimization": {"stage": 2, "zero_hpz_partition_size": 4},
+    }
+    topo = config_topology(cfg)
+    assert topo.sizes["dp"] == 2 and topo.sizes["fsdp"] == 4
+    assert topo.link_kinds.get("dp") == "dcn"
+    assert topology_key(topo) == "dp2dcnxfsdp4"
+    # no topology section -> flat spelling, no dcn suffix
+    del cfg["topology"]
+    assert "dcn" not in topology_key(config_topology(cfg))
+
+
+# ------------------------------------------------- parity pair gating
+def test_hybrid_example_declares_2hop_parity_pair(devices8):
+    from deepspeed_tpu.analysis import config_parity_pairs
+
+    with open(os.path.join(REPO, "examples", "ds_config_hybrid.json")) as f:
+        raw = json.load(f)
+    model = gpt2("gpt2-tiny", vocab_size=64, max_seq_len=16,
+                 hidden_size=32, num_layers=2, num_heads=2)
+    names = [p.name for p in config_parity_pairs(raw, model)]
+    assert "train/grad-rs-2hop-vs-flat" in names
+    # the pair is gated on the knob: a flat-wire config stays silent
+    flat = dict(raw, zero_optimization=dict(
+        raw["zero_optimization"], hierarchical_wire=False))
+    names = [p.name for p in config_parity_pairs(flat, model)]
+    assert "train/grad-rs-2hop-vs-flat" not in names

@@ -65,3 +65,41 @@ def test_tp_innermost_adjacency(devices8):
     flat = grid.reshape(-1)
     for i in range(0, 8, 2):
         assert flat[i].id + 1 == flat[i + 1].id
+
+
+# ------------------------------------------------------ hybrid DCN×ICI
+def test_hybrid_construction_and_link_metadata(devices8):
+    """MeshTopology.hybrid: dp rides DCN, everything else ICI; the DCN
+    axis is outermost so each dp coordinate selects one contiguous
+    (ICI-connected) pod of devices."""
+    topo = MeshTopology.hybrid(ParallelDims(dp=2, fsdp=4))
+    assert topo.is_hybrid
+    assert topo.dcn_axes == ("dp",)
+    assert topo.link_kinds["dp"] == "dcn"
+    assert topo.link_kinds["fsdp"] == "ici"
+    assert "dp=2[dcn]" in repr(topo)
+    # each dp "pod" is a contiguous block of adjacent device ids
+    grid = np.asarray(topo.mesh.devices)
+    flat = grid.reshape(2, 4)
+    for pod in range(2):
+        ids = [d.id for d in flat[pod]]
+        assert ids == list(range(ids[0], ids[0] + 4))
+
+
+def test_hybrid_flat_meshes_stay_all_ici(devices8):
+    topo = MeshTopology(ParallelDims(dp=8))
+    assert not topo.is_hybrid
+    assert topo.dcn_axes == ()
+    assert set(topo.link_kinds.values()) == {"ici"}
+    assert "[dcn]" not in repr(topo)
+
+
+def test_hybrid_rejects_bad_axes(devices8):
+    # an ICI axis preceding the DCN axis in the canonical order means the
+    # DCN axis would not be slowest-varying over the device list
+    with pytest.raises(ValueError, match="outermost"):
+        MeshTopology.hybrid(ParallelDims(dp=2, tp=4), dcn_axes=("tp",))
+    with pytest.raises(ValueError, match="unknown DCN axis"):
+        MeshTopology.hybrid(ParallelDims(dp=2, fsdp=4), dcn_axes=("bogus",))
+    with pytest.raises(ValueError, match="link_kinds"):
+        MeshTopology(ParallelDims(dp=8), link_kinds={"dp": "fast"})

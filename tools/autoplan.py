@@ -100,14 +100,22 @@ def leg_base_config(args) -> dict:
 
 
 def parse_meshes(spec: str):
-    """"8x1,4x2" → [(8, 1), (4, 2)] (dp x tp factorizations)."""
+    """"8x1,4x2" → [(8, 1), (4, 2)] (dp x tp factorizations).
+
+    A ``*`` factors the data axis across the DCN boundary (ISSUE 17):
+    "2*2x2" → (2, 2, 2), a hybrid dcn_dp=2 x fsdp=2 x tp=2 mesh whose
+    outer dp hop prices at DCN bandwidth."""
     out = []
     for part in spec.split(","):
         part = part.strip().lower()
         if not part:
             continue
-        dp, tp = part.split("x")
-        out.append((int(dp), int(tp)))
+        data, tp = part.split("x")
+        if "*" in data:
+            dcn_dp, fsdp = data.split("*")
+            out.append((int(dcn_dp), int(fsdp), int(tp)))
+        else:
+            out.append((int(data), int(tp)))
     return out
 
 
@@ -371,7 +379,9 @@ def main(argv=None) -> int:
                          "rung lost")
     ap.add_argument("--dryrun-mesh", metavar="SHAPES",
                     help="comma list of dpxtp mesh shapes to enumerate "
-                         "statically (e.g. 8x1,4x2,2x4)")
+                         "statically (e.g. 8x1,4x2,2x4); dcn_dp*fsdp "
+                         "spellings (e.g. 2*2x2) build hybrid meshes "
+                         "whose outer dp hop prices at DCN bandwidth")
     ap.add_argument("--check", action="store_true",
                     help="drift-regression gate: compile+measure top-k, "
                          "bank (predicted, measured) pairs, exit 1 when "
