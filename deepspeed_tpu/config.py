@@ -555,6 +555,20 @@ class ServingConfig:
     prefix_cache: bool = True    # hash-of-prefix → shared read-only pages
                                  # with refcounts + copy-on-write (paged
                                  # mode only)
+    host_pages: int = 0          # tiered KV (ISSUE 18): pinned-host page
+                                 # capacity behind the HBM pool. 0 = off;
+                                 # > 0 demotes cold/evicted pages to host
+                                 # (codec-compressed at rest) and promotes
+                                 # them back through the in-step staging
+                                 # buffer — paged mode only
+    spill_codec: str = "fp32"    # at-rest codec for demoted pages
+                                 # (comm/wires.py): fp32 = bitwise spill,
+                                 # int8 = 4x smaller within the codec's
+                                 # lane-wise bound; int8-quantized pools
+                                 # spill their q arrays raw either way
+    spill_dir: Optional[str] = None  # optional NVMe third tier: host-
+                                 # overflowed pages stream to .bin files
+                                 # here through ops/aio (same interface)
     moe_a2a: str = "auto"        # decode-shaped expert-exchange form for
                                  # MoE models served expert-parallel
                                  # (ep > 1): "stock" = GSPMD collectives
@@ -631,6 +645,25 @@ class ServingConfig:
             raise DeepSpeedConfigError(
                 "serving.moe_a2a must be auto|stock|chunked, got "
                 f"{self.moe_a2a!r}"
+            )
+        if int(self.host_pages) < 0:
+            raise DeepSpeedConfigError(
+                f"serving.host_pages must be >= 0 (0 = untiered), got "
+                f"{self.host_pages}"
+            )
+        if int(self.host_pages) > 0 and self.paged is False:
+            # "auto" is fine: resolve_auto_knobs runs before the engine
+            # reads paged, and a tiered config forces it on there
+            raise DeepSpeedConfigError(
+                "serving.host_pages > 0 requires serving.paged: the host "
+                "tier demotes/promotes PAGES of the block-paged arena "
+                "(docs/serving.md \"KV tiering\")"
+            )
+        from .comm.wires import WIRE_NAMES
+        if self.spill_codec not in WIRE_NAMES:
+            raise DeepSpeedConfigError(
+                f"serving.spill_codec must be one of "
+                f"{'|'.join(WIRE_NAMES)}, got {self.spill_codec!r}"
             )
         _check_tristate("serving.spec.enabled", self.spec.enabled)
         _check_tristate("serving.paged", self.paged)
@@ -1465,6 +1498,14 @@ def resolve_auto_knobs(cfg, hardware=None, model_config=None,
                 srv.paged = True
                 report["serving.paged"] = {
                     "value": True, "source": "forced:fleet-disaggregation"
+                }
+            elif int(srv.host_pages) > 0:
+                # KV tiering demotes/promotes PAGES of the block-paged
+                # arena; a host tier without a paged pool is meaningless
+                # — forced on regardless of the table
+                srv.paged = True
+                report["serving.paged"] = {
+                    "value": True, "source": "forced:kv-tiering"
                 }
             else:
                 resolve_bool("serving.paged", True,

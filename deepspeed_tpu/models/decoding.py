@@ -186,6 +186,25 @@ def paged_cow_copy(cache: Cache, page_table: jax.Array, start_pos: jax.Array,
     return out
 
 
+def staged_promote(cache: Cache, stage: Cache,
+                   stage_dst: jax.Array) -> Cache:
+    """Tiered page-in inside the ONE jitted step (serving.host_pages):
+    scatter the promotion staging buffer — ``stage`` leaves are
+    [L, STAGE_SLOTS, ...]-shaped page payloads the engine decoded from
+    the host tier, ``stage_dst`` [STAGE_SLOTS] their physical
+    destinations — into the pool. Runs BEFORE :func:`paged_cow_copy` and
+    the chunk scatter, and the per-slot gathers run after both, so a
+    page promoted this step is attendable this step (scatter-before-
+    gather program order). Unused stage slots point at the NULL sink
+    page: a no-promotion step is a harmless garbage write there and the
+    program never changes shape — one trace across every spill/restore
+    mix."""
+    return {
+        k: v.at[:, stage_dst].set(stage[k].astype(v.dtype))
+        for k, v in cache.items()
+    }
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=jnp.bfloat16, quantized: bool = False) -> Cache:
     """Static KV ring buffer for all layers.

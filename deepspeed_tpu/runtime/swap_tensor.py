@@ -20,60 +20,51 @@ import numpy as np
 from ..ops.aio import AsyncIOHandle
 
 
-class TensorSwapper:
-    def __init__(self, swap_dir: str, num_threads: int = 4,
-                 reuse_buffers: bool = False, buffer_count: int = 4):
-        self.swap_dir = swap_dir
-        os.makedirs(swap_dir, exist_ok=True)
-        self.aio = AsyncIOHandle(num_threads=num_threads)
-        self._meta: Dict[str, Any] = {}
-        # in-flight write requests per name, plus the host buffers they read
-        # from (kept alive until the write completes)
-        self._pending: Dict[str, Any] = {}
-        # two-generation host read-buffer pool (reference: swap_tensor's
-        # pinned buffer_count pool): a generation's buffers are retired
-        # for reuse only after ITS device arrays are block_until_ready
-        # (the H2D copy has landed), and even then one generation later.
-        # Only safe when the consumer COPIES off the buffer (device_put
-        # to a real accelerator); jaxlib's CPU client can zero-copy alias
-        # numpy arrays, so CPU meshes must leave this off (the caller
-        # decides, hence the flag).
-        self._reuse = bool(reuse_buffers)
+class PinnedBufferPool:
+    """Two-generation keyed host-buffer pool (reference: swap_tensor's
+    pinned buffer_count pool), factored out so the serving KV host tier
+    (``serving/paging.HostPageStore``) shares one implementation with the
+    NVMe swapper.
+
+    A generation's buffers are retired for reuse only after ITS consumers
+    have fully landed (the caller blocks before calling
+    ``retire_generation``), and even then one generation later. Only safe
+    when the consumer COPIES off the buffer (device_put to a real
+    accelerator); jaxlib's CPU client can zero-copy alias numpy arrays,
+    so CPU meshes must leave pooling off (the owner decides).
+    """
+
+    def __init__(self, buffer_count: int = 4):
         self._buffer_count = int(buffer_count)
         self._free: Dict[tuple, list] = {}
         self._last_gen: list = []
         self._generation = 0
 
-    def _take_buf(self, shape, dtype) -> np.ndarray:
+    def take(self, shape, dtype) -> np.ndarray:
         key = (tuple(shape), str(dtype))
         lst = self._free.get(key)
         if lst:
             return lst.pop()
         return np.empty(shape, dtype=np.dtype(dtype))
 
-    def _retire_gen(self, bufs: list) -> None:
-        """Rotate generations: the previous swap_in's buffers become
-        reusable now that a newer generation has fully landed.
+    def retire_generation(self, bufs: list, pending_ids=frozenset()) -> None:
+        """Rotate generations: the previous fill's buffers become reusable
+        now that a newer generation has fully landed.
 
         Read-after-overwrite guard (the shardlint R4 hazard class, at the
         host layer): a buffer may never sit in the free pool while an
-        in-flight disk write still reads from it — the next swap_in would
-        overwrite bytes the aio threadpool is persisting. swap_out buffers
-        are freshly materialized hosts (never pooled), so an overlap here
-        is a wiring bug; refuse loudly rather than corrupt the swap file.
+        in-flight write still reads from it — the next fill would
+        overwrite bytes a writer is persisting. ``pending_ids`` is the
+        id() set of buffers still referenced by in-flight writes; refuse
+        loudly rather than corrupt the destination.
         """
-        pending_ids = {
-            id(h)
-            for reqs_hosts in self._pending.values()
-            for h in (reqs_hosts[1] or [])
-        }
         # validate the WHOLE generation before touching the free pool, so
         # a raise leaves no buffer half-retired (in _free AND _last_gen —
         # a later successful retire would then double-free it)
         aliased = [b for b in self._last_gen if id(b) in pending_ids]
         if aliased:
             raise RuntimeError(
-                "TensorSwapper: refusing to recycle a read buffer that "
+                "PinnedBufferPool: refusing to recycle a read buffer that "
                 "an in-flight write still references (read-after-"
                 "overwrite hazard)"
             )
@@ -87,9 +78,51 @@ class TensorSwapper:
 
     @property
     def generation(self) -> int:
+        """Completed buffer generations (observability for tests and
+        stream accounting)."""
+        return self._generation
+
+
+class TensorSwapper:
+    def __init__(self, swap_dir: str, num_threads: int = 4,
+                 reuse_buffers: bool = False, buffer_count: int = 4):
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.aio = AsyncIOHandle(num_threads=num_threads)
+        self._meta: Dict[str, Any] = {}
+        # in-flight write requests per name, plus the host buffers they read
+        # from (kept alive until the write completes)
+        self._pending: Dict[str, Any] = {}
+        self._reuse = bool(reuse_buffers)
+        self._pool = PinnedBufferPool(buffer_count=buffer_count)
+
+    def _take_buf(self, shape, dtype) -> np.ndarray:
+        return self._pool.take(shape, dtype)
+
+    def _retire_gen(self, bufs: list) -> None:
+        """Rotate generations via the shared pool; swap_out buffers are
+        freshly materialized hosts (never pooled), so an alias with an
+        in-flight write here is a wiring bug the pool refuses."""
+        pending_ids = {
+            id(h)
+            for reqs_hosts in self._pending.values()
+            for h in (reqs_hosts[1] or [])
+        }
+        self._pool.retire_generation(bufs, pending_ids=pending_ids)
+
+    @property
+    def generation(self) -> int:
         """Completed read-buffer generations (observability for tests and
         the offload stream accounting)."""
-        return self._generation
+        return self._pool.generation
+
+    @property
+    def _last_gen(self) -> list:
+        """The previous generation's still-referenced buffers (now owned
+        by the shared :class:`PinnedBufferPool`; kept addressable here —
+        tests plant aliases of them to prove the refuse-to-recycle
+        contract)."""
+        return self._pool._last_gen
 
     def _leaf_path(self, name: str, i: int) -> str:
         return os.path.join(self.swap_dir, f"{name}.leaf{i}.bin")

@@ -59,10 +59,12 @@ from ..comm.topology import MeshTopology, ParallelDims
 from ..inference.engine import (InferenceEngine, _align_cache,
                                 init_inference)
 from ..models.decoding import (SCALE_LANES, forward_with_cache, init_cache,
-                               init_paged_cache, paged_cow_copy)
+                               init_paged_cache, paged_cow_copy,
+                               staged_promote)
 from ..models.sharding import use_topology
 from ..utils.logging import log_dist
 from .metrics import ServingMetrics
+from .paging import STAGE_SLOTS
 from .request import Request, RequestState, RequestStatus
 from .scheduler import Scheduler, StepPlan
 from .spec import spec_verify_stream, verify_window
@@ -173,6 +175,53 @@ def paged_kv_stream(cfg, num_pages: int, page_size: int, max_slots: int,
         "pages_per_slot": pages_per_slot,
         "pool_bytes": pool_tokens * (per_tok + scale_tok) * 2,
         "slots": max_slots,
+        "quantized": quantized,
+    }
+
+
+def kv_spill_page_bytes(cfg, page_size: int, codec_name: str,
+                        quantized: bool) -> int:
+    """At-rest bytes of ONE spilled KV page under ``codec_name`` —
+    exactly what serving/paging.encode_page produces: float k/v leaves
+    ride the wire codec on the canonical ``[L, rows, lanes]`` layout;
+    int8 pool leaves spill raw (already 1 byte/elem) with their f32
+    scales codec-compressed."""
+    from ..comm.wires import get_codec
+
+    codec = get_codec(codec_name)
+    L, KV, hd = cfg.num_layers, cfg.kv_heads, cfg.hd
+    if quantized:
+        raw = L * page_size * KV * hd * 1 * 2  # int8 k + v, raw
+        scales = codec.payload_nbytes(L, KV * page_size, SCALE_LANES) * 2
+        return raw + scales
+    return codec.payload_nbytes(L, page_size * KV, hd) * 2  # k + v
+
+
+def kv_spill_stream(cfg, page_size: int, host_pages: int, codec_name: str,
+                    quantized: bool, tp: int = 1) -> Dict[str, Any]:
+    """The ``kv_spill`` analytic stream: steady-state host-DMA traffic of
+    the tiered KV hierarchy, in the shared analytic-streams schema.
+    Upper bound per step: STAGE_SLOTS pages promote in (the rotating
+    staging buffer is that wide — serving/paging.STAGE_SLOTS) and, under
+    sustained pressure, STAGE_SLOTS demotions go out to make room —
+    both at the codec's AT-REST width. Declared ``overlapped``: the
+    page-in rides under the decode step's math (the staged scatter runs
+    before the gathers inside the ONE jitted step), so R8/R13 price it
+    on the host link (``hw.host_bw``) against the step's compute
+    window rather than as exposed tail."""
+    page_bytes = kv_spill_page_bytes(cfg, page_size, codec_name, quantized)
+    total = page_bytes * STAGE_SLOTS * 2  # in + out
+    return {
+        "kind": "offload",
+        "bytes_per_step": total,
+        "per_device_bytes_per_step": total // max(tp, 1),
+        "overlapped": True,  # hidden under the decode step (double-
+                             # buffered staging; R8 budgets the window)
+        "stage_slots": STAGE_SLOTS,
+        "page_size": page_size,
+        "host_pages": host_pages,
+        "codec": codec_name,
+        "page_bytes_at_rest": page_bytes,
         "quantized": quantized,
     }
 
@@ -380,7 +429,7 @@ def _book_seen(seen, tokens, num_new, spec_len, fresh, vocab):
 
 
 def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
-                       max_draft: int = 0):
+                       max_draft: int = 0, tiered: bool = False):
     """Paged twin of :func:`make_step_fn`: same fixed [N, W] discipline,
     two extra traced int32 inputs instead of per-slot cache regions —
 
@@ -393,10 +442,29 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
                                  mid-page copies that page onto its own
                                  frontier page BEFORE the chunk write
 
+    ``tiered`` (serving.host_pages > 0) adds the host-tier staging pair
+    BETWEEN cow_src and fresh —
+
+      stage_kv {leaf: [L, STAGE_SLOTS, ...]}  the rotating staging
+                                 buffer: up to STAGE_SLOTS host pages
+                                 decoded for promotion this step
+      stage_dst [STAGE_SLOTS]    physical destination page per staging
+                                 slot (NULL page = unused slot: its
+                                 scatter lands in the sink)
+
+    and scatters it onto the pool FIRST (models/decoding.staged_promote
+    — before the COW lane and the gathers), so a page promoted this
+    step is attendable this step and the page-in H2D rides under the
+    step's math. The flag is STATIC per engine: an untiered engine's
+    program is byte-identical to pre-tiering, and the tiered program is
+    ONE trace across every spill/restore mix (stage_dst is traced,
+    never baked).
+
     Page allocation/free/refcounts live host-side in the scheduler; the
-    step only COPIES (cow), SCATTERS (the chunk) and GATHERS (per-slot
-    views) through the tables, so every arrival/sharing/divergence mix
-    runs the same compiled program — zero recompiles after warmup."""
+    step only COPIES (cow), SCATTERS (the chunk + staged promotions) and
+    GATHERS (per-slot views) through the tables, so every arrival/
+    sharing/divergence mix runs the same compiled program — zero
+    recompiles after warmup."""
     sample_one = _make_sample_one(vocab)
     moe = bool(getattr(cfg, "is_moe", False))
 
@@ -432,7 +500,22 @@ def make_paged_step_fn(cfg, dtype, vocab: int, cache_shardings=None,
             return caches, seen, out_tok, n_emit, new_rng, moe_stats
         return caches, seen, out_tok, n_emit, new_rng
 
-    return step
+    if not tiered:
+        return step
+
+    def tiered_step(params, caches, seen, tokens, num_new, start_pos,
+                    page_table, cow_src, stage_kv, stage_dst, fresh,
+                    sample_flag, spec_len, eos_id, rng, temperature,
+                    top_k, top_p, rep_penalty):
+        # scatter-before-gather: promoted pages land in the pool before
+        # the COW lane and the per-slot view gathers, so a slot whose
+        # last host page promotes THIS step also schedules this step
+        caches = staged_promote(caches, stage_kv, stage_dst)
+        return step(params, caches, seen, tokens, num_new, start_pos,
+                    page_table, cow_src, fresh, sample_flag, spec_len,
+                    eos_id, rng, temperature, top_k, top_p, rep_penalty)
+
+    return tiered_step
 
 
 class ServingEngine:
@@ -562,9 +645,32 @@ class ServingEngine:
         else:
             self.page_size = self.num_pages = self.pages_per_slot = None
             self.capacity = _align_cache(self.max_tokens + W)
+        # ---- tiered KV (serving.host_pages > 0, ISSUE 18): a pinned-
+        # host second tier behind the HBM pool. The ENGINE owns the
+        # store + spiller (movement needs device access: export/encode on
+        # demotion, decode/stage on promotion); the SCHEDULER owns policy
+        self.host_pages = int(getattr(serving, "host_pages", 0) or 0) \
+            if self.paged else 0
+        self.tiered = self.host_pages > 0
+        self._host_store = self._spiller = None
 
         self.metrics = metrics or ServingMetrics(clock=clock)
-        self.metrics.configure(N, num_pages=self.num_pages or 0)
+        self.metrics.configure(N, num_pages=self.num_pages or 0,
+                               host_pages=self.host_pages)
+        if self.tiered:
+            from .paging import HostPageStore, PageSpiller, export_pages
+
+            self._host_store = HostPageStore(
+                self.host_pages, codec=serving.spill_codec,
+                spill_dir=serving.spill_dir,
+            )
+            # late-bound caches: demote only runs inside plan(), between
+            # steps, when self._caches is the settled functional carry
+            self._spiller = PageSpiller(
+                self._host_store,
+                lambda ids: export_pages(self._caches, ids),
+                metrics=self.metrics,
+            )
         # ---- steptrace (config-gated; None = the zero-overhead path:
         # no span objects exist and every site below guards on it) ------
         self.tracer = None
@@ -632,6 +738,7 @@ class ServingEngine:
             prefix_cache=bool(serving.prefix_cache) if self.paged else False,
             spec_max_draft=self.max_draft,
             spec_ngram_n=self.spec_ngram_n,
+            spiller=self._spiller,
         )
 
         # ---- the KV arena (contiguous slots, or a paged pool) ----------
@@ -663,13 +770,42 @@ class ServingEngine:
             seen = jax.device_put(seen, self.topology.devices[0])
         self._caches = caches
         self._seen = seen
+        # tiered: the rotating in-step staging buffer (the PR-1 double-
+        # buffer carry): TWO numpy fills alternate so the buffer the
+        # device may still be copying from is never the one the next
+        # step's promotions decode into; a zero twin serves idle steps.
+        # Pool-leaf shapes with the page axis narrowed to STAGE_SLOTS.
+        self._stage_idx = 0
+        self._stage_np = None
+        self._stage_zero_np = None
+        if self.tiered:
+            def stage_like():
+                return {
+                    k: np.zeros(
+                        (v.shape[0], STAGE_SLOTS) + tuple(v.shape[2:]),
+                        dtype=v.dtype,
+                    )
+                    for k, v in self._caches.items()
+                }
 
-        make_fn = make_paged_step_fn if self.paged else make_step_fn
-        step_fn = make_fn(
-            self.config, self.dtype, self.config.vocab_size,
-            cache_shardings=self._cache_shardings,
-            max_draft=self.max_draft,
-        )
+            self._stage_np = [stage_like(), stage_like()]
+            self._stage_zero_np = stage_like()
+            self._stage_dst_null = np.full(
+                STAGE_SLOTS, self.null_page, np.int32
+            )
+
+        if self.paged:
+            step_fn = make_paged_step_fn(
+                self.config, self.dtype, self.config.vocab_size,
+                cache_shardings=self._cache_shardings,
+                max_draft=self.max_draft, tiered=self.tiered,
+            )
+        else:
+            step_fn = make_step_fn(
+                self.config, self.dtype, self.config.vocab_size,
+                cache_shardings=self._cache_shardings,
+                max_draft=self.max_draft,
+            )
         # the recompile counter: a trace-time side effect fires once per
         # XLA compile — the zero-recompiles-after-warmup assertion
         self.step_traces = 0
@@ -696,6 +832,11 @@ class ServingEngine:
         arena = (
             f"pages={self.num_pages}x{self.page_size}tok "
             f"({self.pages_per_slot}/slot)"
+            + (
+                f" +host={self.host_pages}@{serving.spill_codec}"
+                + ("+nvme" if serving.spill_dir else "")
+                if self.tiered else ""
+            )
             if self.paged else f"capacity={self.capacity}/slot"
         )
         log_dist(
@@ -807,6 +948,8 @@ class ServingEngine:
             start_pos = plan.start_pos
             paged_args = (jnp.asarray(plan.page_table),
                           jnp.asarray(plan.cow_src))
+            if self.tiered:
+                paged_args += self._stage_args(plan)
         else:
             # rows the plan left idle (num_new == 0) still get a W-wide
             # padded cache write — repoint it at the DEAD TAIL margin
@@ -873,6 +1016,42 @@ class ServingEngine:
         if tr is not None:
             complete_sp.end()
         return finished
+
+    def _stage_args(self, plan: StepPlan) -> tuple:
+        """Decode this step's promotions into the rotating staging buffer
+        (host side) and return the ``(stage_kv, stage_dst)`` step args.
+        An idle step reuses the zero twin and the all-NULL destination
+        vector — same shapes, same dtypes, zero recompiles. The wall
+        time spent here is the page-in STALL (the host-side slice NOT
+        hidden under device math); the H2D upload + scatter themselves
+        ride under the step."""
+        if not plan.stage:
+            return (self._stage_zero_np, self._stage_dst_null)
+        tr = self.tracer
+        page_in_sp = tr.begin("serve/page_in", "serve") if tr else None
+        t0 = self.clock()
+        # rotate: the buffer filled LAST step may still be feeding an
+        # in-flight H2D copy — fill the other one (the PR-1 two-
+        # generation discipline, host side)
+        bufs = self._stage_np[self._stage_idx]
+        self._stage_idx ^= 1
+        stage_dst = np.full(STAGE_SLOTS, self.null_page, np.int32)
+        at_rest = 0
+        for i, s in enumerate(plan.stage):
+            leaves, nbytes = self._spiller.load(s.key)
+            at_rest += nbytes
+            stage_dst[i] = s.dst_page
+            for name, arr in leaves.items():
+                bufs[name][:, i] = arr[:, 0]
+        stall = self.clock() - t0
+        self.metrics.on_page_in(
+            pages=len(plan.stage), nbytes=at_rest, stall_s=stall,
+        )
+        if page_in_sp is not None:
+            page_in_sp.annotate(pages=len(plan.stage),
+                                at_rest_bytes=int(at_rest))
+            page_in_sp.end()
+        return (bufs, stage_dst)
 
     # ------------------------------------------------- fleet KV handoff
     def export_kv_pages(self, page_ids) -> Dict[str, Any]:
@@ -981,6 +1160,16 @@ class ServingEngine:
             streams["kv_cache"] = serving_kv_stream(
                 self.config, self.max_slots, self.capacity,
                 jnp.dtype(self.engine.kv_cache_storage_dtype).itemsize,
+                self.engine.kv_cache_quantized,
+                tp=self.topology.tp_size,
+            )
+        if self.tiered:
+            # the host-tier page traffic (demotions out + staged
+            # promotions in, codec at-rest widths) — declared overlapped
+            # on the host link so R8/R13 budget it against the step
+            streams["kv_spill"] = kv_spill_stream(
+                self.config, self.page_size, self.host_pages,
+                self.serving.spill_codec,
                 self.engine.kv_cache_quantized,
                 tp=self.topology.tp_size,
             )
@@ -1130,6 +1319,7 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         {k: NamedSharding(mesh, cache_specs[k]) for k in cache_shape}
         if sharded else None
     )
+    tiered = paged and int(getattr(srv, "host_pages", 0) or 0) > 0
     paged_args = (
         (
             ("page_table", sds((N, pages_per_slot), jnp.int32, P())),
@@ -1137,6 +1327,18 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         )
         if paged else ()
     )
+    if tiered:
+        # the host-tier staging pair (serving.host_pages > 0): pool-leaf
+        # shapes with the page axis narrowed to STAGE_SLOTS, sharded
+        # like the pool so the linted program is the served program
+        paged_args += (
+            ("stage_kv", {
+                k: sds((v.shape[0], STAGE_SLOTS) + tuple(v.shape[2:]),
+                       v.dtype, cache_specs[k])
+                for k, v in cache_shape.items()
+            }),
+            ("stage_dst", sds((STAGE_SLOTS,), jnp.int32, P())),
+        )
     named_args = (
         ("params", params),
         ("caches", caches),
@@ -1156,9 +1358,15 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
         ("rep_penalty", sds((N,), jnp.float32, P())),
     )
     args = tuple(v for _, v in named_args)
-    make_fn = make_paged_step_fn if paged else make_step_fn
-    step_fn = make_fn(mcfg, dtype, V, cache_shardings=cache_shardings,
-                      max_draft=max_draft)
+    if paged:
+        step_fn = make_paged_step_fn(
+            mcfg, dtype, V, cache_shardings=cache_shardings,
+            max_draft=max_draft, tiered=tiered,
+        )
+    else:
+        step_fn = make_step_fn(mcfg, dtype, V,
+                               cache_shardings=cache_shardings,
+                               max_draft=max_draft)
     # the traced program IS the served program: resolve the expert-
     # exchange form exactly like ServingEngine.__init__ and enter the
     # scope around the trace (R3 then lints the ring's perms when the
@@ -1196,6 +1404,11 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
                 tp=tp,
             )
         }
+    if paged and tiered:
+        streams["kv_spill"] = kv_spill_stream(
+            mcfg, page_size, int(srv.host_pages), srv.spill_codec,
+            quantized, tp=tp,
+        )
     if max_draft > 0:
         streams["spec_verify"] = spec_verify_stream(
             mcfg, N, max_draft, jnp.dtype(storage).itemsize, quantized,
@@ -1219,6 +1432,10 @@ def trace_serving_step(model, ds_config, topology: Optional[MeshTopology]
     ]
     if paged:
         required += ["page_table", "cow_src"]
+    if tiered:
+        # which pages promote varies per tick — baking stage_dst would
+        # recompile on every distinct promotion mix (R11)
+        required += ["stage_dst"]
     meta = {
         "traced_manifest": manifest if lo == len(invars) else {},
         "required_traced": tuple(required) if lo == len(invars) else (),

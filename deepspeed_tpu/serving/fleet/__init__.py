@@ -8,13 +8,14 @@ page transfer (:func:`handoff`). See docs/serving.md "Fleet".
 """
 
 from .handoff import handoff, pages_needed
-from .index import GlobalPrefixIndex
+from .index import HOST_TIER_WEIGHT, GlobalPrefixIndex
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL,
                       ReplicaHandle)
 from .router import Router
 
 __all__ = [
     "GlobalPrefixIndex",
+    "HOST_TIER_WEIGHT",
     "ROLE_DECODE",
     "ROLE_MIXED",
     "ROLE_PREFILL",

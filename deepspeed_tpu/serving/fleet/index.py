@@ -14,6 +14,14 @@ Collisions: the index is hash-only, so a crc32 collision can over-score
 a replica. That mis-routes at worst — the chosen replica's token-verified
 ``PrefixCache.match`` then degrades the hit to a miss, and the fleet
 oracle (any routing == serial replay, token-for-token) is unaffected.
+
+Tiers: replicas with a tiered KV arena (``serving.host_pages > 0``)
+demote evicted chains to their host tier instead of dropping them. The
+index mirrors those too (the cache emits ``kind == "host"`` events) and
+scores them at :data:`HOST_TIER_WEIGHT` per block — a host-resident hit
+still saves the prefill flops but pays a page-in before the first decode
+step, so it outranks a miss and loses to an HBM-resident chain of the
+same depth.
 """
 
 from __future__ import annotations
@@ -22,6 +30,11 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..paging import PrefixCache, chain_hashes, longest_chain_walk
 
+# Per-block routing value of a host-resident chain link relative to an
+# HBM-resident one (1.0). Strictly inside (0, 1): host hit > miss, and
+# any HBM block beats any host block at equal depth.
+HOST_TIER_WEIGHT = 0.5
+
 
 class GlobalPrefixIndex:
     """Per-replica mirrors of full-page chain keys + the scoring walk."""
@@ -29,6 +42,7 @@ class GlobalPrefixIndex:
     def __init__(self, page_size: int):
         self.page_size = int(page_size)
         self._hashes: Dict[int, Set[int]] = {}
+        self._host_hashes: Dict[int, Set[int]] = {}
 
     def attach(self, replica_id: int, cache: PrefixCache) -> None:
         """Subscribe to one replica's cache events. Attach happens at
@@ -43,37 +57,69 @@ class GlobalPrefixIndex:
                 "be comparable across replicas"
             )
         mirror = self._hashes.setdefault(int(replica_id), set())
+        host = self._host_hashes.setdefault(int(replica_id), set())
 
         def listener(event: str, kind: str, h: int, page: int) -> None:
-            if kind != "full":
-                return
-            if event == "insert":
-                mirror.add(h)
+            if kind == "full":
+                tier = mirror
+            elif kind == "host":
+                tier = host
             else:
-                mirror.discard(h)
+                return  # partial tails don't index (sub-page routing)
+            if event == "insert":
+                tier.add(h)
+            else:
+                tier.discard(h)
 
         cache.listener = listener
 
     def longest_chain(self, replica_id: int,
                       token_block_hashes: Sequence[int]) -> int:
         """Chain depth of ``token_block_hashes`` on one replica — the
-        same walk as ``PrefixCache.longest_chain``, over the mirror."""
+        same walk as ``PrefixCache.longest_chain``, over the HBM
+        mirror (host-resident links extend it: the replica can attach
+        them through its host tier just as ``match`` + ``host_chain``
+        would)."""
         mirror = self._hashes.get(int(replica_id), set())
-        return longest_chain_walk(token_block_hashes, mirror.__contains__)
+        host = self._host_hashes.get(int(replica_id), set())
+        return longest_chain_walk(
+            token_block_hashes, lambda h: h in mirror or h in host
+        )
+
+    def weighted_chain(self, replica_id: int,
+                       token_block_hashes: Sequence[int]) -> float:
+        """Tier-weighted chain value: the same leading-run walk, each
+        HBM-resident link worth 1.0 and each host-resident link worth
+        :data:`HOST_TIER_WEIGHT`. The run still breaks at the first
+        block resident in NEITHER tier — a host link deeper in the
+        chain keeps counting (the scheduler promotes through it)."""
+        mirror = self._hashes.get(int(replica_id), set())
+        host = self._host_hashes.get(int(replica_id), set())
+        w = 0.0
+        for h in token_block_hashes:
+            if h in mirror:
+                w += 1.0
+            elif h in host:
+                w += HOST_TIER_WEIGHT
+            else:
+                break
+        return w
 
     def score(self, prompt, eligible: Sequence[int]
-              ) -> List[Tuple[int, int]]:
-        """(replica_id, chain_depth) for every eligible replica, prompt
-        hashed once."""
+              ) -> List[Tuple[int, float]]:
+        """(replica_id, tier-weighted chain value) for every eligible
+        replica, prompt hashed once."""
         hashes = chain_hashes(prompt, self.page_size)
-        return [(rid, self.longest_chain(rid, hashes)) for rid in eligible]
+        return [(rid, self.weighted_chain(rid, hashes))
+                for rid in eligible]
 
     def best(self, prompt, eligible: Sequence[int]
-             ) -> Tuple[Optional[int], int]:
-        """The eligible replica with the deepest chain match, or
-        (None, 0) when nothing matches anywhere (the router then falls
-        back to its load-based tie-break)."""
-        best_rid, best_depth = None, 0
+             ) -> Tuple[Optional[int], float]:
+        """The eligible replica with the highest tier-weighted chain
+        value, or (None, 0) when nothing matches anywhere (the router
+        then falls back to its load-based tie-break). Ties break toward
+        the first eligible replica — stable under mirror churn."""
+        best_rid, best_depth = None, 0.0
         for rid, depth in self.score(prompt, eligible):
             if depth > best_depth:
                 best_rid, best_depth = rid, depth
@@ -81,3 +127,6 @@ class GlobalPrefixIndex:
 
     def entries(self, replica_id: int) -> int:
         return len(self._hashes.get(int(replica_id), set()))
+
+    def host_entries(self, replica_id: int) -> int:
+        return len(self._host_hashes.get(int(replica_id), set()))

@@ -306,7 +306,9 @@ class Router:
             )
             if rid is not None and depth > 0:
                 # cache locality vs balance: a prefix hit saves at most
-                # the matched prefill, so it only wins while the matched
+                # the matched prefill (tier-weighted: host-resident
+                # chains count at HOST_TIER_WEIGHT per block since they
+                # pay a page-in first), so it only wins while the matched
                 # replica isn't meaningfully busier than the idlest one —
                 # a fully-shared system prompt must not serialize the
                 # whole fleet onto one replica (every replica's cache

@@ -102,6 +102,17 @@ class ServingMetrics:
         self.prefill_chunks = 0       # scheduled prompt chunks (a fully-
         #   cached prompt's lone final-token feed does not count)
         self.cached_tail_feeds = 0    # those excluded final-token feeds
+        # tiered KV (serving.host_pages > 0, ISSUE 18)
+        self.pages_spilled = 0        # HBM pages demoted to the host tier
+        self.pages_promoted = 0       # host pages staged back under steps
+        self.spill_bytes = 0          # at-rest (codec-compressed) bytes out
+        self.promote_bytes = 0        # at-rest bytes decoded back in
+        self.page_in_stall_s = 0.0    # host-side blob decode + staging
+        #   time (the part of page-in NOT hidden under device math)
+        self.host_prefix_hits = 0     # admissions that extended a prefix
+        #   hit with >= 1 HOST-tier page (chains that survived eviction)
+        self.host_cached_prompt_tokens = 0  # prompt tokens covered by
+        #   those host-resident blocks (promoted instead of refed)
         # speculative decoding
         self.spec_steps = 0           # verify windows executed (slot-steps
         #   that carried >= 1 draft row)
@@ -125,8 +136,10 @@ class ServingMetrics:
         self.pages_free = 0
         self.arena_utilization = 0.0
         self.prefix_cache_entries = 0
+        self.host_pages_resident = 0  # host-store keys alive (gauge)
         self._max_slots = 1
         self._num_pages = 0
+        self._host_pages = 0
         # per-request samples
         self.ttft_s: List[float] = []
         self.tpot_s: List[float] = []
@@ -195,15 +208,39 @@ class ServingMetrics:
                     (state.finish_t - state.first_token_t) / (n - 1)
                 )
 
-    def on_prefix_lookup(self, cached_tokens: int, prompt_len: int) -> None:
+    def on_prefix_lookup(self, cached_tokens: int, prompt_len: int,
+                         host_tokens: int = 0) -> None:
+        """One slot admission's cache consult. ``cached_tokens`` counts
+        EVERY skipped prompt token (HBM-resident hit + host-tier
+        extension); ``host_tokens`` is the host-tier share of it."""
         self.prefix_lookups += 1
         self.prompt_tokens_seen += int(prompt_len)
         if cached_tokens > 0:
             self.prefix_hits += 1
             self.cached_prompt_tokens += int(cached_tokens)
+        if host_tokens > 0:
+            self.host_prefix_hits += 1
+            self.host_cached_prompt_tokens += int(host_tokens)
 
     def on_cow(self) -> None:
         self.cow_copies += 1
+
+    def on_spill(self, nbytes: int = 0) -> None:
+        """One page demoted HBM → host (at-rest, codec-compressed
+        ``nbytes``); fired by PageSpiller.demote AFTER the put succeeded
+        — a full-store failure mutates nothing and counts nothing."""
+        self.pages_spilled += 1
+        self.spill_bytes += int(_finite(nbytes))
+
+    def on_page_in(self, pages: int = 1, nbytes: int = 0,
+                   stall_s: float = 0.0) -> None:
+        """One step's promotion staging: ``pages`` host pages decoded
+        into the rotating staging buffer (``nbytes`` at rest),
+        ``stall_s`` the host-side decode+staging time — the slice of
+        page-in that is NOT hidden under the device step."""
+        self.pages_promoted += int(pages)
+        self.promote_bytes += int(_finite(nbytes))
+        self.page_in_stall_s += float(_finite(stall_s))
 
     def on_prefill_chunk(self, cached_tail: bool = False) -> None:
         if cached_tail:
@@ -242,12 +279,14 @@ class ServingMetrics:
             return 0.0
         return max(hist) / (total / len(hist))
 
-    def on_pages(self, pool, cache_entries: int = 0) -> None:
+    def on_pages(self, pool, cache_entries: int = 0,
+                 host_resident: int = 0) -> None:
         """Pool gauges from the scheduler's PagePool after a tick."""
         self.pages_free = pool.free_count
         self.pages_in_use = pool.num_pages - pool.free_count
         self.arena_utilization = self.pages_in_use / max(pool.num_pages, 1)
         self.prefix_cache_entries = int(cache_entries)
+        self.host_pages_resident = int(host_resident)
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -255,6 +294,16 @@ class ServingMetrics:
         weighted hit rate; 0.0 before any lookup)."""
         return (
             self.cached_prompt_tokens / self.prompt_tokens_seen
+            if self.prompt_tokens_seen else 0.0
+        )
+
+    @property
+    def host_prefix_hit_rate(self) -> float:
+        """HOST-tier share of the token-weighted hit rate: prompt tokens
+        covered by host-resident blocks (chains that survived HBM
+        eviction) over prompt tokens admitted; 0.0 before any lookup."""
+        return (
+            self.host_cached_prompt_tokens / self.prompt_tokens_seen
             if self.prompt_tokens_seen else 0.0
         )
 
@@ -277,9 +326,11 @@ class ServingMetrics:
         )
 
     # --------------------------------------------------- engine hooks
-    def configure(self, max_slots: int, num_pages: int = 0) -> None:
+    def configure(self, max_slots: int, num_pages: int = 0,
+                  host_pages: int = 0) -> None:
         self._max_slots = max(int(max_slots), 1)
         self._num_pages = max(int(num_pages), 0)
+        self._host_pages = max(int(host_pages), 0)
 
     def on_step(self) -> None:
         self.steps += 1
@@ -326,6 +377,19 @@ class ServingMetrics:
             "mean_accepted_tokens_per_step":
                 self.mean_accepted_tokens_per_step,
         }
+        if (self._host_pages or self.pages_spilled or self.pages_promoted
+                or self.host_pages_resident):
+            snap.update({
+                "pages_spilled": self.pages_spilled,
+                "pages_promoted": self.pages_promoted,
+                "spill_bytes": self.spill_bytes,
+                "promote_bytes": self.promote_bytes,
+                "page_in_stall_s": self.page_in_stall_s,
+                "host_pages_resident": self.host_pages_resident,
+                "host_prefix_hits": self.host_prefix_hits,
+                "host_cached_prompt_tokens": self.host_cached_prompt_tokens,
+                "host_prefix_hit_rate": self.host_prefix_hit_rate,
+            })
         if self.moe_steps:
             snap.update({
                 "moe_steps": self.moe_steps,
@@ -375,6 +439,18 @@ class ServingMetrics:
                 f"cow_copies={self.cow_copies}, "
                 f"prefill_chunks={self.prefill_chunks} "
                 f"(+{self.cached_tail_feeds} cached-tail feeds)"
+            )
+        if self._host_pages or self.pages_spilled or self.pages_promoted:
+            lines.append(
+                f"{'kv tiering':<18}spilled={self.pages_spilled} pages "
+                f"({self.spill_bytes / (1 << 20):.2f} MiB at rest), "
+                f"promoted={self.pages_promoted} "
+                f"({self.promote_bytes / (1 << 20):.2f} MiB), "
+                f"host_resident={self.host_pages_resident}/"
+                f"{self._host_pages}, host prefix hit rate "
+                f"{self.host_prefix_hit_rate:.2f} "
+                f"({self.host_cached_prompt_tokens} tokens), "
+                f"page_in_stall={self.page_in_stall_s * 1e3:.1f}ms"
             )
         if self.spec_steps:
             lines.append(
@@ -433,7 +509,10 @@ class FleetMetrics:
         "steps", "tokens_out", "scheduled_tokens", "prefix_hits",
         "cached_prompt_tokens", "cow_copies", "prefill_chunks",
         "cached_tail_feeds", "spec_steps", "draft_tokens_proposed",
-        "draft_tokens_accepted", "pages_in_use",
+        "draft_tokens_accepted", "pages_in_use", "pages_spilled",
+        "pages_promoted", "spill_bytes", "promote_bytes",
+        "host_prefix_hits", "host_cached_prompt_tokens",
+        "host_pages_resident",
     )
 
     def __init__(self, replica_metrics: List["ServingMetrics"],

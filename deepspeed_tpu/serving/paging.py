@@ -1,12 +1,14 @@
-"""Host-side KV page management: pool allocator + prefix cache.
+"""Host-side KV page management: pool allocator + prefix cache + tiers.
 
 Parity: vLLM's PagedAttention block manager / DeepSpeed-FastGen's blocked
 KV cache, host-side only. The jitted serving step never sees this module
-— it consumes the *result* (per-slot page-table int32 vectors and an
-optional copy-on-write source vector) and keeps its ONE fixed shape.
-The only device-touching functions here are :func:`export_pages` /
-:func:`import_pages`, the eager page-payload transfer the fleet's
-prefill→decode KV handoff runs BETWEEN steps (serving/fleet/handoff.py).
+— it consumes the *result* (per-slot page-table int32 vectors, an
+optional copy-on-write source vector and, tiered, the promotion staging
+buffer) and keeps its ONE fixed shape. The only device-touching
+functions here are :func:`export_pages` / :func:`import_pages`, the
+eager page-payload transfer the fleet's prefill→decode KV handoff runs
+BETWEEN steps (serving/fleet/handoff.py), and the spiller's demote
+export.
 
 - :class:`PagePool` — refcounted free-list over ``num_pages`` physical
   page ids. A page is *live* while any slot or prefix-cache entry holds a
@@ -17,8 +19,18 @@ prefill→decode KV handoff runs BETWEEN steps (serving/fleet/handoff.py).
   ``crc32(block_bytes, prev_hash)``; the partial tail page is stored with
   its valid-token run. Matches verify actual token equality (hash
   collisions degrade to misses, never to wrong KV). Entries hold one pool
-  reference each; LRU eviction under pool pressure drops that reference,
-  freeing the page once no slot shares it.
+  reference each; LRU eviction under pool pressure DEMOTES full-chain
+  entries to the host tier instead of dropping them (when a spiller is
+  attached) — a fleet-wide shared system prompt survives HBM pressure.
+- :class:`HostPageStore` — the second tier: codec-compressed page blobs
+  in pinned-host buffers (the ``runtime/swap_tensor`` two-generation
+  buffer-pool pattern), with an optional NVMe third tier through
+  ``ops/aio`` behind the same put/get/drop interface.
+- :class:`PageSpiller` — the engine↔host bridge: ``demote`` exports one
+  physical page and codec-encodes it at rest (``comm/wires``: fp32 spill
+  is bitwise, int8 within the codec's stated lane-wise bound); ``load``
+  decodes one page back for the step's promotion staging buffer. WHICH
+  pages move is the scheduler's decision; key lifecycle too.
 
 Sharing is read-only: a slot whose write frontier lands inside a shared
 page never writes it in place — the scheduler allocates a fresh page and
@@ -140,6 +152,282 @@ def import_pages(cache: Dict[str, "object"], payload: Dict[str, "object"],
     return scatter_pages(cache, payload, jnp.asarray(ids))
 
 
+# --------------------------------------------- tiered host spill (ISSUE 18)
+# staging-buffer width: pages promoted back per step. TWO slots — the
+# PR-1 rotating double-buffer carry applied to the paged gather: slot A's
+# page-in rides under the step consuming slot B, and the step's staged
+# scatter runs BEFORE its gathers so a promoted page is attendable the
+# same step it lands. Static: the stage arrays' shape is part of the ONE
+# compiled program.
+STAGE_SLOTS = 2
+
+
+def encode_page(payload: Dict[str, "object"], codec
+                ) -> Dict[str, Tuple[str, dict, Dict[str, np.ndarray]]]:
+    """Codec-compress one single-page :func:`export_pages` payload at
+    rest. Float leaves reshape to the wire codec's canonical ``[B, R, L]``
+    operand (B = layers, L = the innermost lane axis) and encode; integer
+    leaves (an int8-quantized pool's q arrays) are stored raw — they are
+    already at storage width. The fp32 codec is the identity, so an fp32
+    spill round-trips bitwise; int8 stays within the codec's stated
+    lane-wise bound (``codec.bound``)."""
+    import jax.numpy as jnp
+
+    blob: Dict[str, Tuple[str, dict, Dict[str, np.ndarray]]] = {}
+    for k, v in payload.items():
+        arr = np.asarray(v)
+        meta = {"shape": tuple(arr.shape), "dtype": str(arr.dtype)}
+        if arr.dtype.kind == "f":
+            x3 = jnp.asarray(arr, jnp.float32).reshape(
+                arr.shape[0], -1, arr.shape[-1]
+            )
+            parts = {
+                pk: np.ascontiguousarray(np.asarray(pv))
+                for pk, pv in codec.encode(x3).items()
+            }
+            blob[k] = ("codec", meta, parts)
+        else:
+            blob[k] = ("raw", meta, {"x": np.ascontiguousarray(arr)})
+    return blob
+
+
+def decode_page(blob, codec) -> Dict[str, np.ndarray]:
+    """Invert :func:`encode_page` back to the pool's leaf shapes/dtypes
+    (numpy — the promotion staging buffer fills from this host-side)."""
+    import jax.numpy as jnp
+
+    out: Dict[str, np.ndarray] = {}
+    for k, (mode, meta, parts) in blob.items():
+        shape = tuple(meta["shape"])
+        dt = np.dtype(meta["dtype"])
+        if mode == "raw":
+            out[k] = parts["x"]
+            continue
+        rows = 1
+        for d in shape[1:-1]:
+            rows *= d
+        dec = codec.decode(
+            {pk: jnp.asarray(pv) for pk, pv in parts.items()},
+            rows, jnp.float32,
+        )
+        out[k] = np.asarray(dec).reshape(shape).astype(dt)
+    return out
+
+
+def blob_nbytes(blob) -> int:
+    """At-rest bytes of one encoded page blob (what the host tier — and
+    the ``kv_spill`` analytic stream — actually pays per page)."""
+    return sum(
+        int(p.nbytes)
+        for _mode, _meta, parts in blob.values()
+        for p in parts.values()
+    )
+
+
+class HostPageStore:
+    """Tier 2 (+3): codec-compressed page blobs in pinned-host buffers,
+    overflowing to NVMe through ``ops/aio`` when ``spill_dir`` is set.
+
+    ``capacity_pages`` bounds the pinned-host tier (the
+    ``serving.host_pages`` knob); the NVMe tier behind it is bounded only
+    by disk. ``put`` returns an opaque int key, or None when every tier
+    is full — in which case nothing was stored (the caller's demotion
+    rolls back to the plain drop path). Buffers recycle through the
+    :class:`runtime.swap_tensor.PinnedBufferPool` two-generation
+    discipline: a dropped blob's buffers become reusable only after the
+    NEXT drop generation retires, so a consumer still decoding the
+    previous generation never sees them overwritten."""
+
+    def __init__(self, capacity_pages: int, codec: str = "fp32",
+                 spill_dir: Optional[str] = None,
+                 buffer_count: int = 4 * STAGE_SLOTS):
+        from ..comm.wires import get_codec
+        from ..runtime.swap_tensor import PinnedBufferPool
+
+        self.capacity = int(capacity_pages)
+        self.codec = get_codec(codec)
+        self.spill_dir = spill_dir
+        self._blobs: Dict[int, dict] = {}   # key -> blob (pinned-host tier)
+        self._disk: Dict[int, dict] = {}    # key -> file skeleton (NVMe)
+        self._next_key = 0
+        self._pool = PinnedBufferPool(buffer_count=buffer_count)
+        self._aio = None
+        self.bytes_resident = 0
+
+    # ------------------------------------------------------------ tiers
+    def _nvme(self):
+        if self._aio is None:
+            import os
+
+            from ..ops.aio import AsyncIOHandle
+
+            os.makedirs(self.spill_dir, exist_ok=True)
+            self._aio = AsyncIOHandle(num_threads=2)
+        return self._aio
+
+    def _to_pinned(self, blob):
+        """Copy a blob's parts into pooled host buffers (the arrays
+        handed in may alias device buffers on a CPU client — the store
+        must own its bytes)."""
+        out = {}
+        for k, (mode, meta, parts) in blob.items():
+            pp = {}
+            for pk, pv in parts.items():
+                buf = self._pool.take(pv.shape, pv.dtype)
+                np.copyto(buf, pv)
+                pp[pk] = buf
+            out[k] = (mode, meta, pp)
+        return out
+
+    def put(self, blob) -> Optional[int]:
+        """Store one encoded page; returns its key, or None when full
+        (host tier at capacity and no NVMe tier configured). On None
+        NOTHING was stored — demotion failure is atomic."""
+        if len(self._blobs) < self.capacity:
+            stored = self._to_pinned(blob)
+            key = self._next_key
+            self._next_key += 1
+            self._blobs[key] = stored
+            self.bytes_resident += blob_nbytes(stored)
+            return key
+        if self.spill_dir is not None:
+            return self._put_disk(blob)
+        return None
+
+    def _put_disk(self, blob) -> int:
+        import os
+
+        aio = self._nvme()
+        key = self._next_key
+        self._next_key += 1
+        skel = {}
+        reqs = []
+        for k, (mode, meta, parts) in blob.items():
+            pp = {}
+            for pk, pv in parts.items():
+                path = os.path.join(
+                    self.spill_dir, f"page{key}.{k}.{pk}.bin"
+                )
+                arr = np.ascontiguousarray(pv)
+                reqs.append((aio.submit_write(path, arr), arr))
+                pp[pk] = (path, tuple(arr.shape), str(arr.dtype))
+            skel[k] = (mode, meta, pp)
+        for r, _buf in reqs:  # buffers stay referenced until the write lands
+            aio.wait(r)
+        self._disk[key] = skel
+        return key
+
+    def get(self, key: int):
+        """The blob for ``key`` (reads the NVMe tier back into fresh host
+        buffers when it overflowed there). Does NOT remove it."""
+        blob = self._blobs.get(key)
+        if blob is not None:
+            return blob
+        skel = self._disk.get(key)
+        if skel is None:
+            raise KeyError(f"HostPageStore: unknown page key {key}")
+        aio = self._nvme()
+        out = {}
+        for k, (mode, meta, pp) in skel.items():
+            parts = {}
+            reqs = []
+            for pk, (path, shape, dt) in pp.items():
+                buf = np.empty(shape, np.dtype(dt))
+                reqs.append(aio.submit_read(path, buf))
+                parts[pk] = buf
+            for r in reqs:
+                aio.wait(r)
+            out[k] = (mode, meta, parts)
+        return out
+
+    def drop(self, key: int) -> None:
+        blob = self._blobs.pop(key, None)
+        if blob is not None:
+            self.bytes_resident -= blob_nbytes(blob)
+            dropped = [
+                p for _m, _meta, parts in blob.values()
+                for p in parts.values()
+            ]
+            self._pool.retire_generation(dropped)
+            return
+        skel = self._disk.pop(key, None)
+        if skel is not None:
+            import os
+
+            for _m, _meta, pp in skel.values():
+                for path, _shape, _dt in pp.values():
+                    try:
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
+            return
+        raise KeyError(f"HostPageStore: dropping unknown page key {key}")
+
+    # ------------------------------------------------------- accounting
+    def __contains__(self, key: int) -> bool:
+        return key in self._blobs or key in self._disk
+
+    def keys(self):
+        return set(self._blobs) | set(self._disk)
+
+    @property
+    def host_count(self) -> int:
+        return len(self._blobs)
+
+    @property
+    def disk_count(self) -> int:
+        return len(self._disk)
+
+    @property
+    def resident_count(self) -> int:
+        return len(self._blobs) + len(self._disk)
+
+    def close(self) -> None:
+        if self._aio is not None:
+            self._aio.close()
+            self._aio = None
+
+
+class PageSpiller:
+    """Demote/load bridge between the device pool and a HostPageStore.
+
+    ``export_fn(page_ids) -> {leaf: [L, n, ...]}`` is late-bound to the
+    engine's CURRENT pool arrays (functional updates: an export after
+    step t reads exactly step t's settled content). Pure data movement —
+    the scheduler decides which pages move and owns key lifecycle."""
+
+    def __init__(self, store: HostPageStore, export_fn, metrics=None):
+        self.store = store
+        self._export = export_fn
+        self.metrics = metrics
+        self.pages_spilled = 0
+        self.pages_loaded = 0
+
+    def demote(self, page_id: int) -> Optional[int]:
+        """Export + codec-encode one physical page into the store.
+        Returns the store key, or None when the store is full — in which
+        case nothing was mutated anywhere (put-before-free: the caller
+        only releases the HBM page on success, so a mid-demotion failure
+        rolls back to the plain drop path atomically)."""
+        blob = encode_page(self._export([page_id]), self.store.codec)
+        key = self.store.put(blob)
+        if key is not None:
+            self.pages_spilled += 1
+            if self.metrics is not None:
+                self.metrics.on_spill(blob_nbytes(blob))
+        return key
+
+    def load(self, key: int) -> Tuple[Dict[str, np.ndarray], int]:
+        """Decode one stored page for the promotion staging buffer:
+        ``({leaf: [L, 1, ...]} numpy in pool dtypes, at-rest bytes)``."""
+        blob = self.store.get(key)
+        self.pages_loaded += 1
+        return decode_page(blob, self.store.codec), blob_nbytes(blob)
+
+    def drop(self, key: int) -> None:
+        self.store.drop(key)
+
+
 class PagePool:
     """Refcounted physical-page allocator (host side, O(1) ops)."""
 
@@ -208,7 +496,7 @@ class PrefixCache:
     always feed at least its final prompt token to sample) and increfs.
     """
 
-    def __init__(self, pool: PagePool, page_size: int):
+    def __init__(self, pool: PagePool, page_size: int, spiller=None):
         self.pool = pool
         self.page_size = int(page_size)
         # full pages: chain_hash -> (page, block_tuple); tails:
@@ -220,11 +508,20 @@ class PrefixCache:
         self._tails: Dict[int, List[Tuple[Tuple[int, ...], int]]] = {}
         self._lru: "OrderedDict[Tuple, None]" = OrderedDict()
         # cache-event listener: ``listener(event, kind, chain_hash, page)``
-        # with event in {"insert", "evict"} and kind in {"full", "tail"}.
-        # The fleet router's GlobalPrefixIndex subscribes here to mirror
-        # each replica's full-page chain keys without polling; None (the
-        # default) is the zero-overhead single-engine path.
+        # with event in {"insert", "evict"} and kind in {"full", "tail",
+        # "host"}. The fleet router's GlobalPrefixIndex subscribes here to
+        # mirror each replica's full-page chain keys (HBM- and host-tier)
+        # without polling; None (the default) is the zero-overhead
+        # single-engine path.
         self.listener = None
+        # ---- host tier (ISSUE 18): evicted FULL chains demote to the
+        # spiller's HostPageStore instead of dropping. chain_hash ->
+        # (store_key, block); its own LRU; pins protect keys whose
+        # promotion a slot is waiting on from host-tier eviction.
+        self.spiller = spiller
+        self._host_full: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        self._host_lru: "OrderedDict[int, None]" = OrderedDict()
+        self._host_pins: Dict[int, int] = {}
 
     def _emit(self, event: str, kind: str, h: int, page: int) -> None:
         if self.listener is not None:
@@ -349,7 +646,10 @@ class PrefixCache:
 
     # --------------------------------------------------------------- evict
     def evict_lru(self) -> bool:
-        """Drop the least-recently-used entry (its pool reference with it).
+        """Evict the least-recently-used entry (its pool reference with
+        it). With a spiller attached, FULL chain entries DEMOTE to the
+        host tier (codec-compressed at rest) instead of vanishing — a
+        later match promotes them back; tails and collisions still drop.
         Returns False when the cache is empty."""
         if not self._lru:
             return False
@@ -357,6 +657,8 @@ class PrefixCache:
         kind, h, page, toks = key
         if kind == "full":
             self._full.pop(h, None)
+            if self.spiller is not None and h not in self._host_full:
+                self._demote_full(h, page, toks)
         else:
             runs = self._tails.get(h, [])
             self._tails[h] = [r for r in runs if r != (toks, page)]
@@ -366,6 +668,90 @@ class PrefixCache:
         self._emit("evict", kind, h, page)
         return True
 
+    # ----------------------------------------------------------- host tier
+    def _demote_full(self, h: int, page: int,
+                     block: Tuple[int, ...]) -> Optional[int]:
+        """Demote one evicted full page to the host tier. On a full
+        store, unpinned host-LRU chains make room first; a still-full
+        store falls back to the plain drop (demotion failure is atomic —
+        :meth:`PageSpiller.demote` mutates nothing on None)."""
+        skey = self.spiller.demote(page)
+        while skey is None and self._evict_host_lru():
+            skey = self.spiller.demote(page)
+        if skey is not None:
+            self._host_full[h] = (skey, block)
+            self._host_lru[h] = None
+            self._emit("insert", "host", h, -1)
+        return skey
+
+    def _evict_host_lru(self) -> bool:
+        """Drop the oldest UNPINNED host-tier chain (pinned keys have a
+        slot's promotion in flight — never yank those)."""
+        for h in list(self._host_lru):
+            skey, _block = self._host_full[h]
+            if self._host_pins.get(skey, 0) == 0:
+                del self._host_lru[h]
+                del self._host_full[h]
+                self.spiller.drop(skey)
+                self._emit("evict", "host", h, -1)
+                return True
+        return False
+
+    def host_chain(self, tokens: Sequence[int], start: int,
+                   max_pages: int) -> List[Tuple[int, int]]:
+        """Continue a chain walk into the host tier: from page-aligned
+        token offset ``start``, the leading run of full blocks whose
+        chained hash has a host-resident entry — token-verified, like
+        :meth:`match` (collisions degrade to misses). Returns
+        ``[(store_key, chain_hash)]`` per matched block; the caller pins
+        each key (:meth:`pin_host`) until its promotion lands."""
+        if self.spiller is None or start % self.page_size != 0:
+            return []
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        ps = self.page_size
+        h = 0
+        for i in range(start // ps):
+            h = chain_hash(h, toks[i * ps: (i + 1) * ps])
+        out: List[Tuple[int, int]] = []
+        pos = start
+        while len(out) < max_pages and pos + ps <= len(toks):
+            block = tuple(toks[pos: pos + ps])
+            nh = chain_hash(h, block)
+            ent = self._host_full.get(nh)
+            if ent is None or ent[1] != block:
+                break
+            out.append((ent[0], nh))
+            self._host_lru.move_to_end(nh)
+            h = nh
+            pos += ps
+        return out
+
+    def pin_host(self, key: int) -> None:
+        self._host_pins[key] = self._host_pins.get(key, 0) + 1
+
+    def unpin_host(self, key: int) -> None:
+        n = self._host_pins.get(key, 0) - 1
+        if n <= 0:
+            self._host_pins.pop(key, None)
+        else:
+            self._host_pins[key] = n
+
+    @property
+    def host_keys(self) -> List[int]:
+        return [skey for skey, _block in self._host_full.values()]
+
+    @property
+    def host_entries(self) -> int:
+        return len(self._host_full)
+
     def clear(self) -> None:
         while self.evict_lru():
             pass
+        # the LRU drain above DEMOTES full chains when tiered — now drop
+        # the host tier too (pins should be empty at clear time; a pinned
+        # key here is a scheduler lifecycle bug surfaced by the store)
+        for h in list(self._host_lru):
+            skey, _block = self._host_full.pop(h)
+            del self._host_lru[h]
+            self.spiller.drop(skey)
+            self._emit("evict", "host", h, -1)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import zlib
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -111,6 +111,16 @@ class RequestState:
     #   prefix-cache refs) — a write into one triggers copy-on-write
     owned_from: int = 0          # first logical page this request owns
     cached_tokens: int = 0       # prompt tokens skipped via the prefix cache
+    # ---- tiered KV (host spill; empty when serving.host_pages == 0) ----
+    host_pages: Dict[int, Tuple[int, bool]] = field(default_factory=dict)
+    #   logical page index -> (HostPageStore key, owned). While any entry
+    #   exists the matching pages[li] is -1 (NULL sink) and the slot is
+    #   unschedulable — the prefetcher promotes <= STAGE_SLOTS per tick
+    #   until the map drains. owned=True keys are dropped from the store
+    #   after promotion; owned=False keys belong to the prefix cache's
+    #   host tier (pinned while referenced here, never dropped by us).
+    last_planned: int = 0        # scheduler tick this slot last made
+    #   progress (demotion victim ordering: coldest slot spills first)
     # ---- speculative decoding (serving/spec.py) -----------------------
     draft_tail: List[int] = field(default_factory=list)  # the previous
     #   verify window's REJECTED targets: stale-but-plausible verifier

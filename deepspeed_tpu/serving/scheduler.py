@@ -30,12 +30,12 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..utils.logging import log_dist
-from .paging import PagePool, PrefixCache
+from .paging import STAGE_SLOTS, PagePool, PrefixCache
 from .request import Request, RequestState, RequestStatus
 from .spec import propose_drafts
 
@@ -50,6 +50,23 @@ class ScheduledWork:
     sample: bool           # does this step produce tokens for the slot?
     spec_len: int = 0      # draft tokens in the row's verify window: the
     #   slot emits 1..spec_len+1 tokens this step depending on acceptance
+
+
+@dataclass
+class StagedPage:
+    """One host→HBM page promotion riding under this step's math.
+
+    The engine decodes ``key``'s blob into the rotating staging buffer
+    and the jitted step scatters it onto physical page ``dst_page``
+    BEFORE the gathers (models/decoding.staged_promote) — the promoted
+    page is attendable the same step. ``owned`` keys are dropped from
+    the host store once the step lands (complete()); shared keys belong
+    to the prefix cache's host tier and are merely unpinned."""
+
+    dst_page: int
+    key: int
+    owned: bool
+    state: RequestState
 
 
 @dataclass
@@ -70,6 +87,10 @@ class StepPlan:
     spec_len: Optional[np.ndarray] = None    # [max_slots] int32 draft
     #   tokens per row (speculative decoding; None/zeros = plain)
     work: List[ScheduledWork] = field(default_factory=list)
+    stage: List[StagedPage] = field(default_factory=list)  # tiered KV:
+    #   <= STAGE_SLOTS host pages promoting under this step (may be
+    #   non-empty with an otherwise idle work list — a promote-only step
+    #   still dispatches so waiting slots become schedulable)
 
     @property
     def total_tokens(self) -> int:
@@ -93,6 +114,7 @@ class Scheduler:
         prefix_cache: bool = False,
         spec_max_draft: int = 0,
         spec_ngram_n: int = 3,
+        spiller=None,
     ):
         self.max_slots = int(max_slots)
         self.token_budget = int(token_budget)
@@ -117,6 +139,22 @@ class Scheduler:
         # ---- block-paged arena bookkeeping (host side; the device only
         # sees the per-step page_table / cow_src int32 vectors) ----------
         self.paged = page_size is not None
+        # ---- tiered KV (serving.host_pages > 0): the engine owns the
+        # HostPageStore + PageSpiller (movement needs device access); the
+        # scheduler owns POLICY — which pages demote under pressure,
+        # which promote into the step's staging slots — plus the key
+        # lifecycle (owned keys drop at complete(); shared prefix keys
+        # stay pinned while a slot's promotion is in flight) -------------
+        self.spiller = spiller if self.paged else None
+        self._ticks = 0               # plan() counter (coldness ordering)
+        self._inflight: Dict[int, bool] = {}  # store key -> owned, for
+        #   promotions between plan() and complete() (invariant checks)
+        self._plan_protect: set = set()  # id(state)s whose pages must not
+        #   demote THIS tick (already planned / promoting — their pages
+        #   are read or written by the step being built)
+        self._promote_focus: Optional[int] = None  # slot index the
+        #   promotion planner is committed to filling to full residency
+        #   (sticky across ticks — see _plan_promotions)
         if self.paged:
             self.page_size = int(page_size)
             self.num_pages = int(num_pages)
@@ -124,8 +162,8 @@ class Scheduler:
             self.null_page = self.num_pages  # physical id of the sink page
             self.pool = PagePool(self.num_pages)
             self.prefix_cache = (
-                PrefixCache(self.pool, self.page_size) if prefix_cache
-                else None
+                PrefixCache(self.pool, self.page_size, spiller=self.spiller)
+                if prefix_cache else None
             )
         else:
             self.pool = self.prefix_cache = None
@@ -222,21 +260,37 @@ class Scheduler:
     # ------------------------------------------------------------- pages
     def _release_pages(self, state: RequestState, insert: bool) -> None:
         pages, state.pages = state.pages, []
+        host, state.host_pages = state.host_pages, {}
         state.owned_from = 0
+        # tiered: entries still waiting on promotion hold store keys, not
+        # HBM pages. Owned keys (slot demotions) die with the slot;
+        # shared keys belong to the prefix cache's host tier — unpin so
+        # host-LRU pressure may reclaim them again
+        for key, owned in host.values():
+            if owned:
+                self.spiller.drop(key)
+            elif self.prefix_cache is not None:
+                self.prefix_cache.unpin_host(key)
         if not pages:
             return
         if insert and self.prefix_cache is not None:
             # KV exists for prompt + generated-but-last (the final sampled
-            # token was never fed back, so its K/V was never written)
+            # token was never fed back, so its K/V was never written).
+            # A -1 placeholder (unpromoted host page) truncates the
+            # publishable run — its HBM content does not exist
+            pub = pages
+            if -1 in pages:
+                pub = pages[: pages.index(-1)]
             frontier = state.prompt_len + max(len(state.tokens) - 1, 0)
             seq = np.concatenate([
                 np.asarray(state.request.prompt, np.int32),
                 np.asarray(state.tokens[:-1], np.int32),
             ])[:frontier]
-            covered = min(len(seq), len(pages) * self.page_size)
-            self.prefix_cache.insert(seq[:covered], pages)
+            covered = min(len(seq), len(pub) * self.page_size)
+            self.prefix_cache.insert(seq[:covered], pub)
         for p in pages:
-            self.pool.decref(p)
+            if p != -1:
+                self.pool.decref(p)
 
     def _attach_prefix(self, state: RequestState) -> None:
         """Prefix-cache lookup at slot admission: the longest cached
@@ -266,19 +320,100 @@ class Scheduler:
             self.pool.incref(p)
         state.pages = list(pages)
         state.owned_from = len(pages)
+        # tiered: the chain may continue in the HOST tier past the
+        # resident hit. Attach those blocks as -1 placeholders + pinned
+        # store keys — the slot waits on promotion instead of refeeding
+        # the prompt. Host pages are whole blocks, so the extension keeps
+        # ``covered`` page-aligned and the write frontier lands exactly
+        # on the first un-promoted page (promoted pages are never
+        # written: no COW interaction).
+        n_host = 0
+        if self.spiller is not None and covered == npages * self.page_size:
+            cap = min(
+                self.pages_per_slot - npages,
+                # the final prompt token must still be FED (sampling):
+                # never cover past prompt_len - 1
+                (state.prompt_len - 1 - covered) // self.page_size,
+            )
+            for key, _h in self.prefix_cache.host_chain(
+                    state.request.prompt, covered, cap):
+                state.host_pages[len(state.pages)] = (key, False)
+                self.prefix_cache.pin_host(key)
+                state.pages.append(-1)
+                covered += self.page_size
+                n_host += 1
         state.cached_tokens = covered
         state.prompt_pos = covered
         if self.metrics is not None:
-            self.metrics.on_prefix_lookup(covered, state.prompt_len)
+            self.metrics.on_prefix_lookup(
+                covered, state.prompt_len,
+                host_tokens=n_host * self.page_size,
+            )
 
-    def _alloc_page(self) -> Optional[int]:
+    def _alloc_page(self, protect=(), stalled_only=False) -> Optional[int]:
         """One fresh page, evicting LRU prefix-cache entries under
-        pressure; None when the pool is truly exhausted."""
+        pressure — and, tiered, demoting cold live-slot pages to the
+        host store; None when every tier is truly exhausted.
+
+        ``protect`` lists RequestStates whose pages must not demote
+        (typically the state the page is being allocated FOR).
+        ``stalled_only`` restricts demotion victims to slots that are
+        ALREADY waiting on host pages — the promotion planner's mode:
+        feeding a waiter must never un-run a resident slot (see
+        :meth:`_plan_promotions` for the liveness argument)."""
         p = self.pool.alloc()
         while p is None and self.prefix_cache is not None \
                 and self.prefix_cache.evict_lru():
             p = self.pool.alloc()
+        while p is None and self.spiller is not None \
+                and self._demote_for_page(protect, stalled_only):
+            p = self.pool.alloc()
         return p
+
+    def _written_tokens(self, state: RequestState) -> int:
+        """KV positions this slot has actually WRITTEN: the chunked
+        prefill frontier, plus — in decode — everything before the
+        current position (the latest sampled token was never fed)."""
+        if state.status is RequestStatus.DECODE:
+            return state.prompt_len + len(state.tokens) - 1
+        return state.prompt_pos
+
+    def _demote_for_page(self, protect=(), stalled_only=False) -> bool:
+        """Spill ONE cold page to the host tier to relieve pool pressure.
+
+        Victim order: coldest slot first (oldest ``last_planned``), its
+        lowest fully-written OWNED page (refcount 1 — shared prefix pages
+        are the cache's to evict, and the frontier page is excluded by
+        the fully-written test so COW never meets a demoted page). The
+        put-before-free contract lives in PageSpiller.demote: on a full
+        host store nothing was mutated and we report failure — the
+        caller falls through to the forced-eviction backstop.
+
+        ``stalled_only`` limits victims to slots already waiting on host
+        pages (they cannot decode this tick anyway, so taking more of
+        their pages costs no progress)."""
+        skip = {id(s) for s in protect} | self._plan_protect
+        victims = sorted(
+            (s for s in self.slots
+             if s is not None and id(s) not in skip
+             and not (stalled_only and not s.host_pages)),
+            key=lambda s: (s.last_planned, s.slot),
+        )
+        ps = self.page_size
+        for state in victims:
+            full = self._written_tokens(state) // ps
+            for li in range(state.owned_from, min(len(state.pages), full)):
+                if state.pages[li] == -1 or li in state.host_pages:
+                    continue
+                key = self.spiller.demote(state.pages[li])
+                if key is None:
+                    return False  # host store full: nothing was mutated
+                page = state.pages[li]
+                state.host_pages[li] = (key, True)
+                state.pages[li] = -1
+                self.pool.decref(page)  # refcount 1 -> frees the page
+                return True
+        return False
 
     def alloc_pages(self, n: int) -> Optional[List[int]]:
         """``n`` fresh pages all-or-nothing (LRU prefix-cache eviction
@@ -331,7 +466,7 @@ class Scheduler:
         ps = self.page_size
         need = min(-(-(start + n) // ps), self.pages_per_slot)
         while len(state.pages) < need:
-            p = self._alloc_page()
+            p = self._alloc_page(protect=(state,))
             if p is None:
                 break
             state.pages.append(p)
@@ -347,7 +482,7 @@ class Scheduler:
             # immediately is safe even if it frees: the step's COW gather
             # reads pre-step pool content, and any new owner's writes land
             # in the later scatter phase.
-            newp = self._alloc_page()
+            newp = self._alloc_page(protect=(state,))
             if newp is None:
                 return 0, -1
             cow = state.pages[fp]
@@ -361,7 +496,15 @@ class Scheduler:
     def assert_page_invariants(self) -> None:
         """The leak invariant after every tick: ``free + live ==
         num_pages``, and every live page's refcount equals exactly the
-        slot + prefix-cache references the scheduler knows about."""
+        slot + prefix-cache references the scheduler knows about.
+
+        Tiered, the ledger spans BOTH tiers: every host-store key must be
+        accounted for by exactly the references the scheduler knows —
+        owned slot demotions, in-flight promotions, and the prefix
+        cache's host chains — and HBM free + HBM live + host-resident
+        must equal the total logical page count. A mid-demotion failure
+        (full host store) mutates nothing, so this holds on every tick
+        including the rollback path."""
         if not self.paged:
             return
         expected: dict = {}
@@ -369,11 +512,33 @@ class Scheduler:
             if st is None:
                 continue
             for p in st.pages:
-                expected[p] = expected.get(p, 0) + 1
+                if p != -1:
+                    expected[p] = expected.get(p, 0) + 1
         if self.prefix_cache is not None:
             for p in self.prefix_cache.held_pages:
                 expected[p] = expected.get(p, 0) + 1
         self.pool.check_leaks(expected)
+        if self.spiller is not None:
+            store = self.spiller.store
+            exp_keys = set(self._inflight)
+            for st in self.slots:
+                if st is None:
+                    continue
+                exp_keys.update(k for k, _ in st.host_pages.values())
+            if self.prefix_cache is not None:
+                exp_keys.update(self.prefix_cache.host_keys)
+            actual = set(store.keys())
+            assert actual == exp_keys, (
+                f"host page leak: store holds {sorted(actual - exp_keys)} "
+                f"unreferenced / missing {sorted(exp_keys - actual)}"
+            )
+            total = (self.pool.free_count + self.pool.live_count
+                     + store.resident_count)
+            assert total == self.num_pages + len(exp_keys), (
+                f"cross-tier page leak: HBM free {self.pool.free_count} + "
+                f"live {self.pool.live_count} + host {store.resident_count}"
+                f" != {self.num_pages} + {len(exp_keys)} logical pages"
+            )
 
     def evict_timeouts(self) -> List[RequestState]:
         """Evict queued requests that waited past request_timeout_s."""
@@ -408,12 +573,81 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.queue) or self.active_count > 0
 
+    def _plan_promotions(self) -> List[StagedPage]:
+        """Drain waiting host pages into this step's staging slots
+        (<= STAGE_SLOTS per tick — the rotating in-step staging buffer is
+        that wide).
+
+        The liveness argument, in three parts. (1) Promotion allocations
+        run ``stalled_only``: a waiter is only ever fed from free pages,
+        LRU prefix chains, or OTHER stalled slots' pages — never by
+        demoting a resident (runnable) slot, so whatever is running keeps
+        running. (2) The planner is STICKY: the slot it started filling
+        (``_promote_focus``) goes first every tick until it has no host
+        pages left — a slot needing more than STAGE_SLOTS pages reaches
+        full residency in ceil(n / STAGE_SLOTS) consecutive ticks instead
+        of round-robining with the other waiters forever. (3) A promoted
+        slot is warmed (``last_planned``) so the victim ordering doesn't
+        eat its pages before it decodes. Without (1)+(2), 4 slots of 4
+        pages over an 8-page pool livelock: 2 pages in, 2 pages out,
+        every tick, zero tokens."""
+        stage: List[StagedPage] = []
+        if self.spiller is None:
+            return stage
+        waiting = sorted(
+            (s for s in self.slots if s is not None and s.host_pages),
+            key=lambda s: (s.last_planned, s.slot),
+        )
+        if self._promote_focus is not None:
+            focus = next(
+                (s for s in waiting if s.slot == self._promote_focus), None
+            )
+            if focus is None:
+                self._promote_focus = None  # drained or slot turned over
+            else:
+                waiting.remove(focus)
+                waiting.insert(0, focus)
+        for state in waiting:
+            if len(stage) >= STAGE_SLOTS:
+                break
+            self._plan_protect.add(id(state))
+            promoted = False
+            for li in sorted(state.host_pages):
+                if len(stage) >= STAGE_SLOTS:
+                    break
+                dst = self._alloc_page(protect=(state,), stalled_only=True)
+                if dst is None:
+                    break  # pool bound even after demotions: wait a tick
+                key, owned = state.host_pages.pop(li)
+                state.pages[li] = dst
+                self._inflight[key] = owned
+                stage.append(StagedPage(dst, key, owned, state))
+                promoted = True
+            if promoted:
+                # a promotion IS progress: warm the slot so the next
+                # tick's victim ordering doesn't re-demote these pages
+                # before the slot ever decodes through them (the other
+                # half of the liveness argument — _plan_protect only
+                # covers THIS tick)
+                state.last_planned = self._ticks
+                if state.host_pages:
+                    # sticky: keep filling THIS slot next tick until it
+                    # is fully resident
+                    self._promote_focus = state.slot
+                    break
+                if state.slot == self._promote_focus:
+                    self._promote_focus = None
+        return stage
+
     def plan(self) -> Optional[StepPlan]:
         """Build the next step's fixed-shape work, or None when idle."""
         now = self.clock()
+        self._ticks += 1
+        self._plan_protect = set()
         self.evict_timeouts()
         self._admit_to_slots(now)
-        plan = self._build_plan()
+        stage = self._plan_promotions()
+        plan = self._build_plan(stage)
         # paged arena: an empty plan while slots are live means page-pool
         # starvation (a live slot always schedules otherwise). Evict the
         # NEWEST in-flight request — gracefully, it can resubmit after
@@ -427,20 +661,39 @@ class Scheduler:
             )
             self._evict(victim, now, "page pool exhausted")
             self._admit_to_slots(now)
-            plan = self._build_plan()
+            plan = self._build_plan(stage)
+        if plan is not None and plan.stage:
+            # a promotion planned for a slot the starvation loop evicted
+            # must not scatter into its (freed) destination page: consume
+            # the key here — _release_pages already dropped the slot's
+            # un-promoted keys, but THESE were popped into the stage list
+            live = [s for s in plan.stage if s.state.slot is not None]
+            for s in plan.stage:
+                if s.state.slot is None:
+                    self._inflight.pop(s.key, None)
+                    if s.owned:
+                        self.spiller.drop(s.key)
+                    elif self.prefix_cache is not None:
+                        self.prefix_cache.unpin_host(s.key)
+            plan.stage = live
         if self.paged:
             self.assert_page_invariants()
             if self.metrics is not None:
                 self.metrics.on_pages(
                     self.pool,
                     len(self.prefix_cache) if self.prefix_cache else 0,
+                    host_resident=(
+                        self.spiller.store.resident_count
+                        if self.spiller is not None else 0
+                    ),
                 )
         if plan is not None and self.metrics is not None:
             self.metrics.on_plan(plan, now, queue_depth=len(self.queue),
                                  occupancy=self.active_count)
         return plan
 
-    def _build_plan(self) -> Optional[StepPlan]:
+    def _build_plan(self, stage: Optional[List[StagedPage]] = None
+                    ) -> Optional[StepPlan]:
         N, W = self.max_slots, self.token_budget
         plan = StepPlan(
             tokens=np.zeros((N, W), np.int32),
@@ -454,6 +707,7 @@ class Scheduler:
             ),
             cow_src=np.full(N, -1, np.int32) if self.paged else None,
             spec_len=np.zeros(N, np.int32),
+            stage=list(stage) if stage else [],
         )
         budget = W
         # decodes first: latency-critical, one committed feed each. The
@@ -466,6 +720,10 @@ class Scheduler:
             state = self.slots[slot]
             if state is None or state.status is not RequestStatus.DECODE:
                 continue
+            if state.host_pages:
+                continue  # tiered: waiting on promotion — attention
+                #   gathers the whole sequence, so a slot with ANY page
+                #   still on host cannot schedule this step
             if budget < 1:
                 break
             pos = state.prompt_len + len(state.tokens) - 1
@@ -474,6 +732,8 @@ class Scheduler:
                 ok, cow = self._prepare_pages(state, pos, 1)
                 if ok < 1:
                     continue  # page pressure: this decode waits a step
+            self._plan_protect.add(id(state))
+            state.last_planned = self._ticks
             decodes.append([slot, state, pos, cow, 0])
             budget -= 1
         self._decode_rr = (self._decode_rr + 1) % N
@@ -519,6 +779,9 @@ class Scheduler:
         for slot, state in prefills:
             if budget < 1:
                 break
+            if state.host_pages:
+                continue  # tiered: prefix tail still on host — the write
+                #   frontier sits past pages that must promote first
             chunk = min(budget, state.prompt_remaining, W)
             lo = state.prompt_pos
             cow = -1
@@ -526,6 +789,8 @@ class Scheduler:
                 chunk, cow = self._prepare_pages(state, lo, chunk)
                 if chunk < 1:
                     continue  # page pressure: the prompt waits a step
+            self._plan_protect.add(id(state))
+            state.last_planned = self._ticks
             plan.tokens[slot, :chunk] = state.request.prompt[lo: lo + chunk]
             plan.num_new[slot] = chunk
             plan.start_pos[slot] = lo
@@ -552,7 +817,7 @@ class Scheduler:
         # margin (ServingEngine._run_plan) — or, paged, their all-NULL
         # page-table row sinks it — so an idle-but-active slot never
         # clobbers its own cached tokens
-        if not plan.work:
+        if not plan.work and not plan.stage:
             return None
         return plan
 
@@ -667,6 +932,16 @@ class Scheduler:
                         st, proposed=w.spec_len,
                         accepted=max(emitted - 1, 0), emitted=emitted,
                     )
+        # tiered: the step consumed its staging buffer — the promoted
+        # pages are HBM-resident now. Owned keys (slot demotions) leave
+        # the host store; shared keys (prefix host tier) merely unpin, so
+        # host-LRU pressure may reclaim them again
+        for s in plan.stage:
+            self._inflight.pop(s.key, None)
+            if s.owned:
+                self.spiller.drop(s.key)
+            elif self.prefix_cache is not None:
+                self.prefix_cache.unpin_host(s.key)
         if self.paged:
             self.assert_page_invariants()
         if self.metrics is not None:

@@ -52,6 +52,10 @@ trace-ready evidence of one statically-visible bug class:
   payload only fits the compute window at ICI speed, not on the
   DCN-tagged axis it crosses (the clean twin splits hierarchically and
   declares the shrunk inter hop)
+- ``kv_spill_unbudgeted``   R8: the tiered serving step's kv_spill
+  host-paging stream with a page too large for the staging window to
+  hide on the host link (the clean twin is the shipped two-slot
+  double-buffer over a real KiB-scale page)
 
 Each has a ``*_clean`` twin proving the rules don't fire on the fixed
 form. All fixtures trace on the 8-device CPU mesh (no execution).
@@ -1051,6 +1055,55 @@ def dcn_unbudgeted_stream_clean():
     return closed, kw, "R13"
 
 
+# ------------------------------------------------------- R8 (kv tiering)
+def _kv_spill_stream(page_bytes: float, stage_slots: int):
+    """The tiered serving step's host-spill stream (serving/engine.py
+    ``kv_spill_stream``): ``stage_slots`` pages in + ``stage_slots``
+    pages out per step, declared overlapped because the staged-gather
+    hides the page-in under decode. The hazard sizes a page so large
+    the double-buffer window can never hide it on the host link; the
+    clean twin is the shipped two-slot staging buffer over a real page."""
+    mesh = corpus_mesh()
+
+    def prog(x, w):
+        return jnp.einsum("bk,kn->bn", x, w).sum()
+
+    x = jax.ShapeDtypeStruct((64, 128), jnp.float32)
+    w = jax.ShapeDtypeStruct((128, 128), jnp.float32)
+    closed = jax.make_jaxpr(prog)(x, w)
+    nbytes = float(page_bytes) * stage_slots * 2  # demote + promote
+    kw = {
+        "mesh": mesh,
+        "streams": {
+            "kv_spill": {
+                "kind": "offload",
+                "bytes_per_step": nbytes,
+                "per_device_bytes_per_step": nbytes,
+                "overlapped": True,
+                "stage_slots": stage_slots,
+                "page_bytes_at_rest": float(page_bytes),
+                "codec": "fp32",
+            }
+        },
+    }
+    return closed, kw
+
+
+def kv_spill_unbudgeted():
+    # an 8 GiB page x 2 staging slots x 2 directions is ~1 s of host
+    # DMA per step — no decode window hides it; the overlap claim is
+    # statically false
+    closed, kw = _kv_spill_stream(8 * (1 << 30), stage_slots=2)
+    return closed, kw, "R8"
+
+
+def kv_spill_unbudgeted_clean():
+    # a real page (2 layers x 16 tok x 4 kv-heads x 8 hd x 4 B k+v) is
+    # KiB-scale — the double-buffered window hides it under anything
+    closed, kw = _kv_spill_stream(32 * 1024, stage_slots=2)
+    return closed, kw, "R8"
+
+
 HAZARDS = [
     stacked_dim0_drift,
     slot_cache_carry_drift,
@@ -1076,6 +1129,7 @@ HAZARDS = [
     static_arg_per_tick,
     dcn_flat_ring,
     dcn_unbudgeted_stream,
+    kv_spill_unbudgeted,
 ]
 
 CLEAN_TWINS = [
@@ -1103,4 +1157,5 @@ CLEAN_TWINS = [
     static_arg_per_tick_clean,
     dcn_flat_ring_clean,
     dcn_unbudgeted_stream_clean,
+    kv_spill_unbudgeted_clean,
 ]

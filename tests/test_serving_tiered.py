@@ -1,0 +1,572 @@
+"""Tiered KV memory hierarchy (ISSUE 18): host-spill paging.
+
+The oracle: a TIERED replay (fp32 spill codec) under forced demotion and
+promotion mid-stream — paged, spec-on, tp=2 — must reproduce an untiered
+replay of the same logical capacity token-for-token, with
+``step_traces == 1`` across any spill/restore mix (page-in rides under
+the decode step as a staged scatter, never as a second program). Plus:
+HostPageStore unit behavior (capacity, put-before-free rollback, the
+NVMe third tier), codec-at-rest round trips (fp32 bitwise, int8 within
+``codec.bound``, int8-arena pages lossless), prefix chains that demote
+to host instead of dying and re-attach on a cold session resume, the
+cross-tier page-leak invariant, tiering metrics, oversubscription
+absorbed where the untiered twin sheds, and tier-aware fleet routing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.serving import (Request, RequestStatus, ServingEngine,
+                                   ServingMetrics)
+from deepspeed_tpu.serving.paging import (STAGE_SLOTS, HostPageStore,
+                                          PageSpiller, chain_hashes,
+                                          decode_page, encode_page)
+
+
+def tiny_llama(**kw):
+    d = dict(vocab_size=128, max_seq_len=128, hidden_size=32, num_layers=2,
+             num_heads=4, num_kv_heads=2, intermediate_size=64)
+    d.update(kw)
+    return llama("llama-tiny", **d)
+
+
+def _engine(model, **kw):
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("max_tokens", 96)
+    kw.setdefault("rng", jax.random.PRNGKey(1))
+    return deepspeed_tpu.init_inference(model, **kw)
+
+
+def _serving(eng, **over):
+    serving = {"max_slots": 2, "token_budget": 16, "max_tokens": 96,
+               "paged": True, "page_size": 16, "request_timeout_s": 1e9}
+    serving.update(over)
+    return ServingEngine(engine=eng, serving=serving)
+
+
+def _drain(srv, outs=None):
+    outs = outs if outs is not None else {}
+    for st in srv.run_until_idle(max_steps=4000):
+        if st.status is RequestStatus.DONE:
+            outs[st.request.request_id] = st.output().tolist()
+    return outs
+
+
+def _submit_all(srv, reqs):
+    for r in reqs:
+        srv.submit(r)
+
+
+def _churn_requests(rng, n, sys_prompt=None, plen=(20, 28), new=8):
+    """Prompts long enough that two concurrent slots oversubscribe a
+    small pool (live-slot demotion + promotion, not just chain spills)."""
+    reqs = []
+    for i in range(n):
+        tail = rng.randint(1, 128, size=rng.randint(*plen)).astype(np.int32)
+        p = tail if sys_prompt is None else np.concatenate([sys_prompt, tail])
+        reqs.append(Request(request_id=f"r{i}", prompt=p, max_new_tokens=new))
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# the bitwise oracle: tiered == untiered, token for token, ONE trace
+# ---------------------------------------------------------------------------
+def test_tiered_equals_untiered_greedy_bitwise_with_churn():
+    """Forced demotion AND promotion mid-stream: 4 slots can hold up to
+    20 pages of KV but only 8 exist, so live slots spill to host and
+    page back in continuously — every token must still match an
+    untiered replay at the same LOGICAL capacity."""
+    model = tiny_llama()
+    eng = _engine(model)
+    rng = np.random.RandomState(0)
+    sys_prompt = rng.randint(1, 128, size=16).astype(np.int32)
+    reqs = _churn_requests(rng, 8, sys_prompt, plen=(16, 25), new=20)
+
+    tiered = _serving(eng, max_slots=4, num_pages=8, host_pages=72,
+                      spill_codec="fp32")
+    _submit_all(tiered, reqs)
+    got = _drain(tiered)
+
+    untiered = _serving(eng, max_slots=4, num_pages=80)
+    _submit_all(untiered, reqs)
+    want = _drain(untiered)
+
+    m = tiered.metrics
+    assert m.pages_spilled > 0, "the pool never demoted — no churn"
+    assert m.pages_promoted > 0, "nothing paged back in — no promotion"
+    assert got == want
+    assert tiered.step_traces == 1
+    assert untiered.step_traces == 1
+
+
+def test_cold_session_resume_promotes_host_chain_bitwise():
+    """A finished session's prefix chain, LRU-evicted to the host tier
+    by filler traffic, re-attaches on an identical prompt: the resume
+    pays a page-in (host prefix hit, pages promoted through the staging
+    buffer) and reproduces the original greedy session exactly."""
+    model = tiny_llama()
+    eng = _engine(model)
+    srv = _serving(eng, num_pages=8, host_pages=12, spill_codec="fp32")
+    rng = np.random.RandomState(0)
+    pA = rng.randint(1, 128, size=40).astype(np.int32)  # 2 full pages
+
+    outs = {}
+    srv.submit(Request(request_id="a0", prompt=pA, max_new_tokens=6))
+    _drain(srv, outs)
+    for i in range(4):  # disjoint fillers pressure A's chain out of HBM
+        pf = rng.randint(1, 128, size=50 + i).astype(np.int32)
+        srv.submit(Request(request_id=f"f{i}", prompt=pf, max_new_tokens=6))
+    _drain(srv, outs)
+    assert srv.scheduler.prefix_cache.host_entries > 0
+    srv.submit(Request(request_id="a1", prompt=pA, max_new_tokens=6))
+    _drain(srv, outs)
+
+    m = srv.metrics
+    assert m.host_prefix_hits >= 1
+    assert m.pages_promoted >= 1
+    assert m.host_cached_prompt_tokens >= 16
+    assert outs["a1"] == outs["a0"]
+    assert srv.step_traces == 1
+
+
+def test_tiered_spec_on_parity():
+    """Speculative decoding over the tiered arena: a spec slot's verify
+    window and the staged page-in share the one step; repetitive prompts
+    land acceptances while pages churn through the host tier."""
+    model = tiny_llama()
+    eng = _engine(model)
+    rng = np.random.RandomState(2)
+    reqs = []
+    for i in range(5):
+        motif = rng.randint(1, 128, size=3)
+        p = np.tile(motif, 12)[: 20 + i].astype(np.int32)
+        reqs.append(Request(request_id=f"r{i}", prompt=p, max_new_tokens=10))
+    spec = {"enabled": True, "max_draft": 3}
+
+    tiered = _serving(eng, max_slots=3, num_pages=8, host_pages=40,
+                      spill_codec="fp32", spec=spec)
+    _submit_all(tiered, reqs)
+    got = _drain(tiered)
+
+    untiered = _serving(eng, max_slots=3, num_pages=48, spec=spec)
+    _submit_all(untiered, reqs)
+    want = _drain(untiered)
+
+    assert tiered.metrics.pages_spilled > 0
+    assert got == want
+    assert tiered.step_traces == 1
+
+
+def test_tiered_tp2_int8_arena_parity():
+    """tp=2 mesh, int8-quantized pool: spilled pages carry raw int8
+    codewords + fp32 scales (bitwise round trip), the staging buffers
+    stay host-committed numpy (no sharding-induced retrace)."""
+    model = tiny_llama(num_heads=4, num_kv_heads=4)
+    topo = MeshTopology(dims=ParallelDims(tp=2), devices=jax.devices()[:2])
+    eng = _engine(model, topology=topo, kv_cache_dtype="int8",
+                  rng=jax.random.PRNGKey(4))
+    rng = np.random.RandomState(3)
+    reqs = _churn_requests(rng, 6, plen=(24, 40), new=12)
+
+    tiered = _serving(eng, max_slots=3, num_pages=8, host_pages=40,
+                      spill_codec="fp32")
+    _submit_all(tiered, reqs)
+    got = _drain(tiered)
+
+    untiered = _serving(eng, max_slots=3, num_pages=48)
+    _submit_all(untiered, reqs)
+    want = _drain(untiered)
+
+    assert tiered.metrics.pages_spilled > 0
+    assert got == want
+    assert tiered.step_traces == 1
+
+
+# ---------------------------------------------------------------------------
+# codec at rest
+# ---------------------------------------------------------------------------
+def _fake_page(rng, L=2, ps=16, KV=2, hd=8, dtype=np.float32):
+    return {
+        "k": rng.standard_normal((L, 1, ps, KV, hd)).astype(dtype),
+        "v": rng.standard_normal((L, 1, ps, KV, hd)).astype(dtype),
+    }
+
+
+def test_fp32_spill_codec_roundtrip_bitwise():
+    from deepspeed_tpu.comm.wires import get_codec
+
+    codec = get_codec("fp32")
+    page = _fake_page(np.random.default_rng(0))
+    out = decode_page(encode_page(page, codec), codec)
+    for name, arr in page.items():
+        np.testing.assert_array_equal(out[name], arr, err_msg=name)
+        assert out[name].dtype == arr.dtype
+
+
+def test_int8_spill_codec_within_stated_bound():
+    """A lossy spill codec degrades restored KV by no more than the
+    codec's DOCUMENTED wire bound — the same |decode(encode(x)) - x| <=
+    codec.bound(x) contract every wire in comm/wires.py ships under."""
+    from deepspeed_tpu.comm.wires import get_codec
+
+    codec = get_codec("int8")
+    page = _fake_page(np.random.default_rng(1))
+    out = decode_page(encode_page(page, codec), codec)
+    for name, arr in page.items():
+        # encode_page's canonical codec operand: [layers, rows, lanes]
+        blocks = arr.reshape(arr.shape[0], -1, arr.shape[-1])
+        bound = np.broadcast_to(
+            np.asarray(codec.bound(blocks)), blocks.shape
+        ).reshape(arr.shape)
+        err = np.abs(out[name].astype(np.float64) - arr.astype(np.float64))
+        assert (err <= bound + 1e-12).all(), (name, err.max())
+
+
+def test_int8_arena_page_spills_lossless():
+    """Quantized-arena pages keep their raw int8 codewords at rest (only
+    the fp32 scales ride the codec) — the round trip is bitwise, so an
+    int8 arena never degrades by being demoted."""
+    from deepspeed_tpu.comm.wires import get_codec
+
+    rng = np.random.default_rng(2)
+    codec = get_codec("fp32")
+    page = {
+        "k": rng.integers(-128, 128, (2, 1, 16, 2, 8), dtype=np.int8),
+        "v": rng.integers(-128, 128, (2, 1, 16, 2, 8), dtype=np.int8),
+        "k_scale": rng.standard_normal((2, 1, 2, 16, 8)).astype(np.float32),
+        "v_scale": rng.standard_normal((2, 1, 2, 16, 8)).astype(np.float32),
+    }
+    out = decode_page(encode_page(page, codec), codec)
+    for name, arr in page.items():
+        np.testing.assert_array_equal(out[name], arr, err_msg=name)
+        assert out[name].dtype == arr.dtype
+
+
+# ---------------------------------------------------------------------------
+# HostPageStore: capacity, rollback, the NVMe third tier
+# ---------------------------------------------------------------------------
+def test_host_store_capacity_and_spiller_rollback():
+    from deepspeed_tpu.comm.wires import get_codec
+
+    store = HostPageStore(capacity_pages=2, codec="fp32")
+    rng = np.random.default_rng(3)
+    pages = {i: _fake_page(rng) for i in range(3)}
+    spiller = PageSpiller(store, lambda ids: pages[ids[0]])
+
+    k0 = spiller.demote(0)
+    k1 = spiller.demote(1)
+    assert k0 is not None and k1 is not None
+    assert store.resident_count == 2
+    # put-before-free: a full store refuses, nothing was mutated
+    assert spiller.demote(2) is None
+    assert store.resident_count == 2
+    assert sorted(store.keys()) == sorted([k0, k1])
+    # load round-trips bitwise and reports at-rest bytes
+    leaves, nbytes = spiller.load(k0)
+    np.testing.assert_array_equal(leaves["k"], pages[0]["k"])
+    assert nbytes > 0
+    spiller.drop(k0)
+    assert store.resident_count == 1
+    assert spiller.demote(2) is not None  # freed capacity admits again
+
+
+def test_host_store_nvme_third_tier_roundtrip(tmp_path):
+    """With spill_dir set, host-tier overflow lands on disk behind the
+    same put/get/drop interface and pages back bitwise."""
+    store = HostPageStore(capacity_pages=1, codec="fp32",
+                          spill_dir=str(tmp_path))
+    rng = np.random.default_rng(4)
+    blobs = {}
+    keys = []
+    for i in range(3):
+        page = _fake_page(rng)
+        blobs[i] = page
+        keys.append(store.put(encode_page(page, store.codec)))
+    assert all(k is not None for k in keys)
+    assert store.host_count == 1
+    assert store.disk_count == 2
+    assert store.resident_count == 3
+    for i, k in enumerate(keys):  # disk gets paid back through the codec
+        out = decode_page(store.get(k), store.codec)
+        np.testing.assert_array_equal(out["k"], blobs[i]["k"])
+    for k in keys:
+        store.drop(k)
+    assert store.resident_count == 0
+    store.close()
+
+
+def test_engine_spill_dir_roundtrip(tmp_path):
+    """End-to-end: a tiered engine whose host tier is 2 pages deep
+    overflows to NVMe and still replays bitwise."""
+    model = tiny_llama()
+    eng = _engine(model)
+    rng = np.random.RandomState(5)
+    reqs = _churn_requests(rng, 6, plen=(20, 28), new=12)
+
+    tiered = _serving(eng, max_slots=3, num_pages=8, host_pages=2,
+                      spill_codec="fp32", spill_dir=str(tmp_path))
+    _submit_all(tiered, reqs)
+    got = _drain(tiered)
+    untiered = _serving(eng, max_slots=3, num_pages=48)
+    _submit_all(untiered, reqs)
+    want = _drain(untiered)
+
+    store = tiered._host_store
+    assert tiered.metrics.pages_spilled > 0
+    assert store.host_count + store.disk_count == store.resident_count
+    assert got == want
+    assert tiered.step_traces == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-tier accounting
+# ---------------------------------------------------------------------------
+def test_cross_tier_leak_invariant_after_churn():
+    """assert_page_invariants runs after EVERY tick; after a churn-heavy
+    replay the explicit cross-tier ledger must close: HBM free + HBM
+    live + host-resident == num_pages + live store keys."""
+    model = tiny_llama()
+    eng = _engine(model)
+    srv = _serving(eng, max_slots=4, num_pages=8, host_pages=40,
+                   spill_codec="fp32")
+    rng = np.random.RandomState(6)
+    _submit_all(srv, _churn_requests(rng, 8, plen=(16, 25), new=16))
+    _drain(srv)
+    sch = srv.scheduler
+    sch.assert_page_invariants()
+    store = srv._host_store
+    assert (sch.pool.free_count + sch.pool.live_count + store.resident_count
+            == srv.num_pages + len(list(store.keys())))
+
+
+def test_demotion_rollback_when_every_tier_is_full():
+    """Mid-demotion failure (store full) rolls back to the plain-drop
+    path: the victim keeps its pages, the invariants still close, the
+    replay still finishes correct (forced evictions allowed — tiering
+    degrades to the untiered policy, never corrupts)."""
+    model = tiny_llama()
+    eng = _engine(model)
+    # a 1-page host tier saturates immediately under this churn
+    srv = _serving(eng, max_slots=4, num_pages=8, host_pages=1,
+                   spill_codec="fp32")
+    rng = np.random.RandomState(7)
+    reqs = _churn_requests(rng, 6, plen=(16, 25), new=12)
+    _submit_all(srv, reqs)
+    got = _drain(srv)
+    srv.scheduler.assert_page_invariants()
+    assert srv._host_store.resident_count <= 1
+    untiered = _serving(eng, max_slots=4, num_pages=48)
+    _submit_all(untiered, reqs)
+    want = _drain(untiered)
+    for rid, toks in got.items():  # evicted requests may be missing; the
+        assert toks == want[rid]   # finished ones must still be bitwise
+    assert srv.step_traces == 1
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def test_tiering_metrics_snapshot_keys_and_nan_hardening():
+    m = ServingMetrics()
+    m.configure(4, num_pages=8, host_pages=16)
+    m.on_spill(1024)
+    m.on_spill(float("nan"))          # NaN-hardened: counts the page,
+    m.on_page_in(pages=2, nbytes=2048, stall_s=float("nan"))
+    m.on_page_in(pages=1, nbytes=1024, stall_s=0.5)
+    m.on_prefix_lookup(32, 64, host_tokens=16)
+    snap = m.snapshot()
+    for key in ("pages_spilled", "pages_promoted", "spill_bytes",
+                "promote_bytes", "page_in_stall_s", "host_pages_resident",
+                "host_prefix_hits", "host_cached_prompt_tokens",
+                "host_prefix_hit_rate"):
+        assert key in snap, key
+        assert np.isfinite(snap[key]), key
+    assert snap["pages_spilled"] == 2
+    assert snap["pages_promoted"] == 3
+    assert snap["spill_bytes"] == 1024   # the NaN byte count dropped
+    assert snap["page_in_stall_s"] == pytest.approx(0.5)
+    assert snap["host_prefix_hits"] == 1
+    assert "kv tiering" in m.summary()
+
+
+def test_untiered_snapshot_omits_tiering_keys():
+    m = ServingMetrics()
+    m.configure(4, num_pages=8)
+    assert "pages_spilled" not in m.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# oversubscription: the tier absorbs what the untiered pool sheds
+# ---------------------------------------------------------------------------
+def test_oversubscription_no_shed_where_untiered_sheds():
+    model = tiny_llama()
+    eng = _engine(model)
+    rng = np.random.RandomState(8)
+    reqs = _churn_requests(rng, 10, plen=(30, 40), new=20)
+
+    tiered = _serving(eng, max_slots=4, num_pages=8, host_pages=72,
+                      spill_codec="fp32")
+    _submit_all(tiered, reqs)
+    _drain(tiered)
+    assert tiered.metrics.evict_reasons.get("page pool exhausted", 0) == 0
+    assert tiered.metrics.finished == len(reqs)
+
+    untiered = _serving(eng, max_slots=4, num_pages=8)
+    _submit_all(untiered, reqs)
+    _drain(untiered)
+    assert untiered.metrics.evict_reasons.get("page pool exhausted", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# config + analysis surface
+# ---------------------------------------------------------------------------
+def test_host_pages_forces_paged_auto():
+    from deepspeed_tpu.config import ServingConfig, resolve_auto_knobs
+
+    cfg = ServingConfig(enabled=True, host_pages=8, paged="auto")
+    report = resolve_auto_knobs(cfg)
+    assert cfg.paged is True
+    assert report["serving.paged"]["source"] == "forced:kv-tiering"
+
+
+def test_host_pages_without_paged_rejected():
+    from deepspeed_tpu.config import DeepSpeedConfigError, ServingConfig
+
+    cfg = ServingConfig(enabled=True, host_pages=8, paged=False)
+    with pytest.raises(DeepSpeedConfigError):
+        cfg.validate()
+
+
+def test_bad_spill_codec_rejected():
+    from deepspeed_tpu.config import DeepSpeedConfigError, ServingConfig
+
+    cfg = ServingConfig(enabled=True, paged=True, host_pages=8,
+                        spill_codec="zstd")
+    with pytest.raises(DeepSpeedConfigError):
+        cfg.validate()
+
+
+def test_tiered_step_lints_clean_and_declares_kv_spill():
+    """The tiered step traces abstractly for shardlint: R1-R13 clean,
+    the kv_spill stream declared (kind offload, overlapped, staged
+    bytes), stage_dst in R11's required-traced manifest."""
+    from deepspeed_tpu.analysis import lint_serving_config
+    from deepspeed_tpu.serving.engine import trace_serving_step
+    from deepspeed_tpu.config import DeepSpeedConfig
+
+    model = tiny_llama()
+    cfg = {"serving": {"enabled": True, "max_slots": 2, "token_budget": 8,
+                       "max_tokens": 64, "paged": True, "page_size": 16,
+                       "num_pages": 8, "host_pages": 16,
+                       "spill_codec": "fp32"}}
+    report = lint_serving_config(cfg, model=model)
+    assert report.ok, report.format()
+
+    ds = DeepSpeedConfig(dict(cfg))
+    topo = MeshTopology(dims=ParallelDims(tp=1), devices=jax.devices()[:1])
+    closed, shardings, streams, meta = trace_serving_step(model, ds, topo)
+    assert "kv_spill" in streams
+    spill = streams["kv_spill"]
+    assert spill["kind"] == "offload"
+    assert spill["overlapped"] is True
+    assert spill["stage_slots"] == STAGE_SLOTS
+    assert spill["bytes_per_step"] == pytest.approx(
+        spill["page_bytes_at_rest"] * STAGE_SLOTS * 2
+    )
+    assert "stage_dst" in meta["required_traced"]
+
+
+def test_engine_analytic_streams_declare_kv_spill():
+    model = tiny_llama()
+    eng = _engine(model)
+    srv = _serving(eng, num_pages=8, host_pages=16, spill_codec="int8")
+    streams = srv.analytic_streams()
+    assert "kv_spill" in streams
+    assert streams["kv_spill"]["codec"] == "int8"
+    untiered = _serving(eng, num_pages=8)
+    assert "kv_spill" not in untiered.analytic_streams()
+
+
+# ---------------------------------------------------------------------------
+# fleet: tier-aware prefix routing
+# ---------------------------------------------------------------------------
+def test_fleet_tier_aware_routing_replay():
+    """A session's chain demoted to replica 0's HOST tier still routes
+    the resumed session to replica 0 (host hit > miss), which re-attaches
+    and promotes — and an HBM-resident chain outranks a host one."""
+    from deepspeed_tpu.serving.fleet import Router
+
+    model = tiny_llama()
+    router = Router(
+        engine=_engine(model),
+        serving={"max_slots": 2, "token_budget": 16, "max_tokens": 96,
+                 "paged": True, "page_size": 16, "num_pages": 8,
+                 "host_pages": 12, "spill_codec": "fp32",
+                 "request_timeout_s": 1e9,
+                 "fleet": {"enabled": True, "replicas": 2,
+                           "routing": "prefix"}})
+    rng = np.random.RandomState(0)
+    pA = rng.randint(1, 128, size=40).astype(np.int32)
+    router.submit(Request("a0", pA, max_new_tokens=6))
+    router.run_until_idle()
+    # churn until r0's pool pressure demotes A's chain to its host tier
+    cache0 = router.replicas[0].engine.scheduler.prefix_cache
+    fills = 0
+    while cache0.host_entries == 0 and fills < 24:
+        pf = rng.randint(1, 128, size=50 + fills % 4).astype(np.int32)
+        router.submit(Request(f"f{fills}", pf, max_new_tokens=6))
+        fills += 1
+        if fills % 4 == 0:
+            router.run_until_idle()
+    router.run_until_idle()
+    assert cache0.host_entries > 0, "replica 0 never demoted"
+
+    idx = router.index
+    hashes = chain_hashes(pA, 16)
+    w0 = idx.weighted_chain(0, hashes)
+    w1 = idx.weighted_chain(1, hashes)
+    assert w0 > 0, "replica 0 lost A's chain entirely"
+    assert w1 == 0.0
+    rid, depth = idx.best(pA, [0, 1])
+    assert rid == 0 and depth == w0
+
+    pre = router.metrics.prefix_routed
+    router.submit(Request("a1", pA, max_new_tokens=6))
+    router.run_until_idle()
+    assert router.metrics.prefix_routed == pre + 1
+    m0 = router.replicas[0].engine.metrics
+    assert m0.host_prefix_hits + m0.pages_promoted > 0
+    assert router.step_traces[0] == 1
+    assert all(t <= 1 for t in router.step_traces)
+
+
+def test_index_weighted_chain_tiers():
+    """Unit: HBM links score 1.0, host links HOST_TIER_WEIGHT, the walk
+    breaks at the first block resident in neither tier."""
+    from deepspeed_tpu.serving.fleet import (HOST_TIER_WEIGHT,
+                                             GlobalPrefixIndex)
+    from deepspeed_tpu.serving.paging import PagePool, PrefixCache
+
+    idx = GlobalPrefixIndex(page_size=16)
+    cache = PrefixCache(PagePool(8), 16)
+    idx.attach(0, cache)
+    listener = cache.listener
+    listener("insert", "full", 101, 0)
+    listener("insert", "host", 102, -1)
+    listener("insert", "full", 103, 1)
+    listener("insert", "host", 104, -1)
+    assert idx.weighted_chain(0, [101, 102, 103]) == pytest.approx(
+        1.0 + HOST_TIER_WEIGHT + 1.0
+    )
+    # break at the first miss: 999 is in neither tier
+    assert idx.weighted_chain(0, [101, 999, 103]) == pytest.approx(1.0)
+    # depth walk counts both tiers (the replica can attach through host)
+    assert idx.longest_chain(0, [101, 102, 103, 104]) == 4
+    listener("evict", "host", 102, -1)
+    assert idx.weighted_chain(0, [101, 102]) == pytest.approx(1.0)
+    assert idx.host_entries(0) == 1

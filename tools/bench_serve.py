@@ -130,6 +130,8 @@ def _serving_section(args) -> dict:
         "paged": args.paged,
         "page_size": args.page_size,
         "num_pages": args.num_pages,
+        "host_pages": args.kv_host_pages,
+        "spill_codec": args.kv_spill_codec,
         "prefix_cache": not args.no_prefix_cache,
         "moe_a2a": args.moe_a2a,
         "spec": {
@@ -208,6 +210,97 @@ def _replay_stats(finished, clock):
              if st.first_token_t is not None]
     dur = max(clock(), 1e-9)
     return tokens, tokens / dur, percentile(ttfts, 95)
+
+
+def _twin_replay(args, engine, trace, num_pages, host_pages=0):
+    """Replay the same trace through a twin engine with an explicit page
+    budget — the inline oracle legs of --check-tiered-parity. Returns
+    ({request_id: tokens} over requests that actually finished, count of
+    "page pool exhausted" forced evictions)."""
+    from deepspeed_tpu.serving import Request, ServingEngine, ServingMetrics
+
+    clock = VirtualClock()
+    serving = _serving_section(args)
+    serving["num_pages"] = int(num_pages)
+    serving["host_pages"] = int(host_pages)
+    srv = ServingEngine(engine=engine, clock=clock,
+                        metrics=ServingMetrics(clock=clock),
+                        serving=serving)
+    pending = list(trace)
+    finished = []
+    while pending or srv.scheduler.has_work:
+        while pending and pending[0][0] <= clock():
+            at, rid, prompt, new = pending.pop(0)
+            st = srv.submit(Request(request_id=rid, prompt=prompt,
+                                    max_new_tokens=new,
+                                    temperature=args.temperature))
+            if st.finished:
+                finished.append(st)
+        if not srv.scheduler.has_work:
+            clock.advance(max(pending[0][0] - clock(), 1e-6))
+            continue
+        finished.extend(srv.step())
+        clock.advance(1e-3)  # virtual: the twin cares about tokens only
+    toks = {st.request.request_id: list(st.tokens) for st in finished
+            if not st.evict_reason}
+    exhausted = int(
+        srv.metrics.evict_reasons.get("page pool exhausted", 0)
+    )
+    return toks, exhausted
+
+
+def _cold_resume(args, srv, clock, trace, baseline_tokens):
+    """--cold-resume K: re-submit the first K prompts as FRESH sessions
+    after the main replay has churned the pool — their prefix chains (if
+    anywhere) now live in the host tier, so first-token latency includes
+    the page-in the staging path is supposed to hide. Prints measured
+    page-in TTFT next to the analytic host-link budget. Returns (pages
+    promoted during the resume, greedy-token mismatches vs the original
+    sessions)."""
+    import time as _time
+
+    from deepspeed_tpu.analysis.cost.hardware import HardwareModel
+    from deepspeed_tpu.serving import Request
+    from deepspeed_tpu.serving.metrics import percentile
+
+    m = srv.metrics
+    promoted0, stall0 = m.pages_promoted, m.page_in_stall_s
+    hits0, bytes0 = m.host_prefix_hits, m.promote_bytes
+    states = []
+    for i in range(min(args.cold_resume, len(trace))):
+        at, orig, prompt, new = trace[i]
+        st = srv.submit(Request(request_id=f"resume-{i}", prompt=prompt,
+                                max_new_tokens=new,
+                                temperature=args.temperature))
+        states.append((st, orig))
+    while srv.scheduler.has_work:
+        t0 = _time.perf_counter()
+        srv.step()
+        clock.advance(_time.perf_counter() - t0)
+    ttfts = [st.first_token_t - st.arrival_t for st, _ in states
+             if st.first_token_t is not None]
+    promoted = m.pages_promoted - promoted0
+    stall = m.page_in_stall_s - stall0
+    nbytes = m.promote_bytes - bytes0
+    budget = nbytes / HardwareModel.detect().host_bw if nbytes else 0.0
+    print(
+        f"cold resume: {len(states)} sessions, p95 TTFT "
+        f"{(percentile(ttfts, 95) or 0.0) * 1e3:.1f} ms, host prefix "
+        f"hits +{m.host_prefix_hits - hits0}, paged in {promoted} pages "
+        f"({nbytes / 2**20:.3f} MiB), page-in stall {stall * 1e3:.2f} ms "
+        f"(host-link budget {budget * 1e3:.2f} ms)"
+    )
+    mismatch = 0
+    if args.temperature == 0.0:
+        # greedy resume of an identical prompt must reproduce the
+        # original session token-for-token — restored-from-host KV is
+        # the same KV (fp32 spill is bitwise; int8 re-quantizes to the
+        # same codewords it was quantized from)
+        for st, orig in states:
+            want = baseline_tokens.get(orig)
+            if want is not None and list(st.tokens) != want:
+                mismatch += 1
+    return promoted, mismatch
 
 
 def _fleet_replay(args, engine, hw_section) -> int:
@@ -435,6 +528,32 @@ def main(argv=None) -> int:
                          "(slots * pages_per_slot, no overcommit)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable prefix sharing in --paged mode")
+    ap.add_argument("--kv-host-pages", type=int, default=0, metavar="N",
+                    help="tiered KV (--paged): back the HBM page pool "
+                         "with N host-resident pages — cold pages and "
+                         "LRU-evicted prefix chains demote to pinned "
+                         "host memory (codec-compressed at rest) and "
+                         "page back in under the decode step "
+                         "(serving.host_pages; docs/serving.md "
+                         "\"KV tiering\")")
+    ap.add_argument("--kv-spill-codec", default="fp32",
+                    choices=["fp32", "bf16", "int8", "int4"],
+                    help="at-rest codec for host-spilled pages "
+                         "(serving.spill_codec; fp32 round-trips "
+                         "bitwise)")
+    ap.add_argument("--cold-resume", type=int, default=0, metavar="K",
+                    help="after the replay, re-submit the first K "
+                         "prompts as fresh sessions and print their "
+                         "page-in TTFT next to the analytic host-link "
+                         "budget (the cold-session-resume leg)")
+    ap.add_argument("--check-tiered-parity", action="store_true",
+                    help="exit 1 unless the tiered replay (a) forced "
+                         "zero \"page pool exhausted\" evictions while "
+                         "an untiered twin at the same HBM page count "
+                         "sheds, and (b) reproduces an untiered twin of "
+                         "the same LOGICAL capacity token-for-token "
+                         "(the kv-tiering CI oracle; needs "
+                         "--kv-host-pages)")
     ap.add_argument("--system-prompt", type=int, default=0, metavar="LEN",
                     help="prepend one shared LEN-token system prompt to "
                          "every request (prefix-heavy trace)")
@@ -501,6 +620,14 @@ def main(argv=None) -> int:
     if (args.hw_queue_depth is not None or args.hw_ttft_p95 is not None
             or args.postmortem or args.check_health):
         args.healthwatch = True
+    if args.kv_host_pages > 0 and not args.paged:
+        ap.error("--kv-host-pages needs --paged (the host tier backs "
+                 "the block-paged arena)")
+    if args.check_tiered_parity and args.kv_host_pages <= 0:
+        ap.error("--check-tiered-parity needs --kv-host-pages > 0")
+    if args.check_tiered_parity and args.replicas > 1:
+        ap.error("--check-tiered-parity is a single-engine oracle "
+                 "(the fleet replay has its own serial-replay oracle)")
 
     import jax
     import jax.numpy as jnp
@@ -620,6 +747,17 @@ def main(argv=None) -> int:
             f"prompt tokens), cow_copies={m['cow_copies']}, "
             f"prefill_chunks={m['prefill_chunks']}"
         )
+    if args.kv_host_pages > 0:
+        print(
+            f"kv tiering: +{srv.host_pages} host pages @ "
+            f"{args.kv_spill_codec}, spilled={m['pages_spilled']} "
+            f"({m['spill_bytes'] / 2**20:.3f} MiB) "
+            f"promoted={m['pages_promoted']} "
+            f"({m['promote_bytes'] / 2**20:.3f} MiB), page-in stall "
+            f"{m['page_in_stall_s'] * 1e3:.2f} ms, host prefix hit rate "
+            f"{m['host_prefix_hit_rate']:.2f}, resident now "
+            f"{m['host_pages_resident']}"
+        )
     if args.spec:
         print(
             f"spec: {m['spec_steps']} verify windows, acceptance rate "
@@ -633,6 +771,14 @@ def main(argv=None) -> int:
         f"(zero-after-warmup criterion: 1), lockstep engine compiles="
         f"{engine.num_compiles}"
     )
+    resume_promoted, resume_mismatch = 0, 0
+    if args.cold_resume > 0:
+        baseline_tokens = {
+            st.request.request_id: list(st.tokens) for st in finished
+        }
+        resume_promoted, resume_mismatch = _cold_resume(
+            args, srv, clock, trace, baseline_tokens
+        )
     if args.trace:
         out = srv.trace_export(args.trace)
         print(f"steptrace: wrote {out} "
@@ -677,6 +823,53 @@ def main(argv=None) -> int:
     if args.check_recompiles and srv.step_traces != 1:
         print("ERROR: the slot step recompiled after warmup")
         return 1
+    if args.check_tiered_parity:
+        exhausted = int(
+            srv.metrics.evict_reasons.get("page pool exhausted", 0)
+        )
+        # twin 1: untiered, same LOGICAL capacity — the token oracle
+        want, _ = _twin_replay(
+            args, engine, trace,
+            num_pages=srv.num_pages + srv.host_pages,
+        )
+        # twin 2: untiered, same HBM page count — must be the one that
+        # sheds (the tier bought real capacity, not just latency)
+        _, twin_exhausted = _twin_replay(
+            args, engine, trace, num_pages=srv.num_pages
+        )
+        got = {st.request.request_id: list(st.tokens) for st in finished}
+        print(
+            f"tiered parity: tiered pool-exhausted evictions="
+            f"{exhausted}, untiered twin at {srv.num_pages} HBM pages "
+            f"sheds {twin_exhausted}, token oracle over "
+            f"{len(want)} requests"
+        )
+        if exhausted:
+            print(f"ERROR: the tiered replay forced {exhausted} "
+                  "\"page pool exhausted\" evictions — the host tier "
+                  "failed to absorb the oversubscription")
+            return 1
+        if twin_exhausted == 0:
+            print("ERROR: the untiered twin never exhausted its pool — "
+                  "the trace does not oversubscribe; raise --requests "
+                  "or shrink --num-pages")
+            return 1
+        for rid, toks in want.items():
+            if rid in got and got[rid] != toks:
+                print(f"ERROR: {rid} diverged from the untiered "
+                      f"equal-capacity replay ({got[rid]} != {toks})")
+                return 1
+        if args.cold_resume > 0:
+            if resume_promoted == 0:
+                print("ERROR: cold resume never paged anything in — "
+                      "the host tier held no chain for the resumed "
+                      "prompts")
+                return 1
+            if resume_mismatch:
+                print(f"ERROR: {resume_mismatch} resumed sessions "
+                      "diverged from their original greedy replay "
+                      "(restored-from-host KV is wrong)")
+                return 1
     if args.check_moe_parity:
         want = _moe_parity_replay(args, trace)
         got = {st.request.request_id: list(st.tokens) for st in finished}
