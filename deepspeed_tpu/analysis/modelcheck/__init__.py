@@ -1,0 +1,45 @@
+"""fleetcheck: exhaustive host-plane model checking.
+
+The dynamic sibling of shardlint (static jaxpr rules) and paritycheck
+(differential trace certificates): where those check the DEVICE
+programs, fleetcheck checks the HOST control plane — scheduler, paging,
+KV tiers, fleet routing/handoff — by exhaustively exploring event
+interleavings of small bounded configs against safety invariants H1–H7
+and a liveness (quiescence) obligation, with replayable minimal
+counterexample traces.
+
+The objects under test are the REAL production classes (Scheduler,
+PagePool, PrefixCache, HostPageStore, PageSpiller, ReplicaHandle,
+Router + handoff); only the device engine and the clock are nulled.
+There is no model-vs-implementation gap to maintain: a scheduler
+refactor is checked the moment it lands.
+
+Entry points:
+
+- :func:`explore` — bounded BFS over a :class:`Scenario`, → a
+  :class:`CheckResult` (invariant ids, traces, state counts).
+- :func:`random_walk` — one seeded deep walk (the randomized smoke and
+  the determinism-audit regression).
+- :func:`preset` / ``PRESETS`` — the curated scenario families the CLI
+  and CI run (oversubscription, disaggregated_handoff,
+  tiered_cold_resume, spec_on, fleet_shedding).
+- ``MUTATIONS`` — the seeded-bug corpus (serving/faults.py seams) each
+  with the invariant/liveness id fleetcheck MUST report.
+
+CLI: ``tools/fleetcheck.py``. Catalog + theory: ``docs/modelcheck.md``.
+"""
+
+from .explore import CheckResult, Violation, WalkResult, explore, \
+    random_walk
+from .fingerprint import fingerprint
+from .invariants import INVARIANTS, CheckFailure, check_world
+from .scenarios import MUTATIONS, PRESETS, Mutation, RequestSpec, \
+    Scenario, preset
+from .world import World, replay
+
+__all__ = [
+    "explore", "random_walk", "CheckResult", "Violation", "WalkResult",
+    "fingerprint", "INVARIANTS", "CheckFailure", "check_world",
+    "PRESETS", "MUTATIONS", "Mutation", "RequestSpec", "Scenario",
+    "preset", "World", "replay",
+]

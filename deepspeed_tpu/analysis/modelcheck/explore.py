@@ -1,0 +1,356 @@
+"""fleetcheck exploration: bounded BFS over event interleavings.
+
+State model: **replay-from-scratch**. A state IS its event trace; to
+expand a node the explorer replays the trace into a fresh
+:class:`~.world.World` (cheap — fake clock, null device, numpy-only),
+applies one more event, checks H1–H7, fingerprints, dedups. No deepcopy
+ever touches the live host objects, and every counterexample is a
+replayable trace by construction. BFS order makes the first reported
+counterexample a MINIMAL one (no shorter trace reaches a violation).
+
+Per discovered state the explorer also runs the **liveness drain**: the
+all-EOS policy (every sampler emits EOS, handoffs run, nothing else
+arrives) must reach quiescence — all submitted requests DONE/EVICTED —
+within ``drain_horizon`` ticks. A fingerprint recurring at unchanged
+cumulative progress during the drain is a **LIVELOCK** (the PR 18
+promotion-thrash class); horizon exhaustion is **NO_QUIESCENCE**. A
+quiesce-cache of fingerprints already known to drain keeps the pass
+near-linear.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ...serving import faults
+from .fingerprint import fingerprint
+from .invariants import INVARIANTS, CheckFailure
+from .scenarios import Scenario
+from .world import World, replay
+
+__all__ = ["explore", "random_walk", "CheckResult", "Violation"]
+
+# cap on sampler-outcome combinations enumerated per tick event; the
+# presets stay far under it (<= 4 samplers), it only guards pathology
+_MAX_OUTCOME_COMBOS = 128
+
+
+@dataclass
+class Violation:
+    invariant: str            # H1..H7 | LIVELOCK | NO_QUIESCENCE | ...
+    message: str
+    trace: Tuple[tuple, ...]  # replayable event trace reaching it
+    replica: Optional[int] = None
+
+    def format(self) -> str:
+        what = INVARIANTS.get(self.invariant, "")
+        lines = [f"VIOLATION {self.invariant}"
+                 + (f" — {what}" if what else ""),
+                 f"  {self.message}",
+                 f"  trace ({len(self.trace)} events):"]
+        for i, ev in enumerate(self.trace):
+            lines.append(f"    {i + 1:2d}. {_fmt_event(ev)}")
+        return "\n".join(lines)
+
+
+@dataclass
+class CheckResult:
+    scenario: Scenario
+    violations: List[Violation] = field(default_factory=list)
+    states: int = 0
+    transitions: int = 0
+    max_depth_reached: int = 0
+    truncated: bool = False
+    drains: int = 0
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def format(self) -> str:
+        sc = self.scenario
+        head = (
+            f"fleetcheck: {sc.describe()}\n"
+            f"  explored {self.states} states / {self.transitions} "
+            f"transitions to depth {self.max_depth_reached} "
+            f"({'bounds hit' if self.truncated else 'exhaustive'}), "
+            f"{self.drains} liveness drains, {self.elapsed_s:.2f}s"
+        )
+        if self.ok:
+            return head + "\n  OK — H1-H7 hold and every state quiesces"
+        return head + "\n" + "\n".join(
+            v.format() for v in self.violations
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario.name,
+            "ok": self.ok,
+            "states": self.states,
+            "transitions": self.transitions,
+            "max_depth_reached": self.max_depth_reached,
+            "truncated": self.truncated,
+            "drains": self.drains,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "violations": [
+                {"invariant": v.invariant, "message": v.message,
+                 "trace": [list(map(str, ev)) for ev in v.trace]}
+                for v in self.violations
+            ],
+        }
+
+
+def _fmt_event(ev: tuple) -> str:
+    kind = ev[0]
+    if kind in ("submit", "resubmit"):
+        return f"{kind} q{ev[1]}"
+    if kind == "advance":
+        return f"advance clock (dt index {ev[1]})"
+    if kind == "handoff":
+        return "handoff pass"
+    if kind == "tick":
+        out = ev[2]
+        if out is None:
+            return f"tick r{ev[1]} (all-EOS drain)"
+        if isinstance(out, int):
+            return f"tick r{ev[1]} (random mask {out:#x})"
+        return f"tick r{ev[1]} outcomes [{', '.join(out) or 'promote-only'}]"
+    return repr(ev)
+
+
+def _tick_events(world: World) -> Tuple[List[tuple], List[Violation]]:
+    """Enumerate tick events enabled in ``world``'s state, with every
+    sampler-outcome combination. MUTATES world (plan() admits/evicts) —
+    callers pass a throwaway probe replay."""
+    events: List[tuple] = []
+    violations: List[Violation] = []
+    base = tuple(world.trace)
+    for rid in world.tickable():
+        sched = world.replicas[rid].engine.scheduler
+        try:
+            plan = sched.plan()
+        except CheckFailure as e:
+            violations.append(Violation(
+                e.invariant, str(e), base + (("tick", rid, ()),), rid))
+            continue
+        except AssertionError as e:
+            violations.append(Violation(
+                "INTERNAL_ASSERT", str(e) or "assertion failed",
+                base + (("tick", rid, ()),), rid))
+            continue
+        if plan is None:
+            # plan() may still have evicted timeouts / admitted — the
+            # idle tick is a real event; dedup absorbs true no-ops
+            events.append(("tick", rid, ()))
+            continue
+        alphabets = []
+        for w in plan.work:
+            if not w.sample:
+                continue
+            syms = ["tok", "eos"]
+            if w.spec_len >= 1:
+                syms.append("acc")
+            alphabets.append(syms)
+        combos = itertools.islice(
+            itertools.product(*alphabets), _MAX_OUTCOME_COMBOS
+        )
+        for outcomes in combos:
+            events.append(("tick", rid, tuple(outcomes)))
+    return events, violations
+
+
+def _drain(world: World, quiesce_cache: Set) -> Optional[Violation]:
+    """All-EOS liveness drain, in place. Returns a LIVELOCK /
+    NO_QUIESCENCE violation or None (quiesced)."""
+    sc = world.scenario
+    seen: List = []
+    progress_at: Dict = {}
+    start_progress = world.progress
+    for step in range(sc.drain_horizon):
+        if world.quiescent():
+            quiesce_cache.update(seen)
+            return None
+        fp = fingerprint(world)
+        if fp in quiesce_cache:
+            quiesce_cache.update(seen)
+            return None
+        if fp in progress_at and progress_at[fp] == world.progress:
+            return Violation(
+                "LIVELOCK",
+                f"drain revisited a state after "
+                f"{step - seen.index(fp)} ticks with zero token "
+                f"progress — the system cycles without ever finishing "
+                f"its {sum(1 for s in world.states if s is not None)} "
+                f"live requests",
+                tuple(world.trace),
+            )
+        progress_at[fp] = world.progress
+        seen.append(fp)
+        # one drain round: every busy replica ticks all-EOS, then one
+        # handoff pass moves finished prefills so decode replicas drain
+        for rid in world.tickable():
+            world.apply(("tick", rid, None), check=False)
+        if world.router is not None and world.router._decode:
+            if any(rep.role == "prefill" and rep.decode_candidates()
+                   for rep in world.replicas):
+                world.apply(("handoff",), check=False)
+    if world.quiescent():
+        quiesce_cache.update(seen)
+        return None
+    return Violation(
+        "NO_QUIESCENCE",
+        f"still not quiescent after {sc.drain_horizon} all-EOS drain "
+        f"ticks (progress {start_progress} -> {world.progress})",
+        tuple(world.trace),
+    )
+
+
+def _safe_drain(world: World, quiesce_cache: Set) -> Optional[Violation]:
+    """_drain, with production-side assertion trips surfaced as
+    violations instead of crashing the exploration."""
+    try:
+        return _drain(world, quiesce_cache)
+    except CheckFailure as e:
+        return Violation(e.invariant, str(e), tuple(world.trace))
+    except AssertionError as e:
+        return Violation("INTERNAL_ASSERT", str(e) or "assertion failed",
+                         tuple(world.trace))
+
+
+def explore(scenario: Scenario, stop_on_first: bool = True
+            ) -> CheckResult:
+    """Exhaustive bounded exploration of one scenario. Arms the
+    scenario's seeded faults for the whole run (clean scenarios arm
+    nothing)."""
+    t0 = time.monotonic()
+    res = CheckResult(scenario)
+    quiesce_cache: Set = set()
+
+    def out_of_budget() -> bool:
+        return (time.monotonic() - t0 > scenario.budget_s
+                or res.states >= scenario.max_states)
+
+    with faults.arming(*scenario.mutations):
+        root = World(scenario)
+        visited = {fingerprint(root)}
+        res.states = 1
+        lv = _safe_drain(root, quiesce_cache)
+        if lv is not None:
+            res.violations.append(lv)
+            if stop_on_first:
+                res.elapsed_s = time.monotonic() - t0
+                return res
+        frontier: deque = deque([()])
+        while frontier:
+            if out_of_budget():
+                res.truncated = True
+                break
+            trace = frontier.popleft()
+            if len(trace) >= scenario.max_depth:
+                # the depth bound is part of the scenario's definition —
+                # exploring every interleaving UP TO it is exhaustive
+                continue
+            probe = replay(scenario, trace)
+            events = probe.enabled_nontick()
+            tick_evs, tick_violations = _tick_events(probe)
+            events.extend(tick_evs)
+            for v in tick_violations:
+                res.violations.append(v)
+                if stop_on_first:
+                    res.elapsed_s = time.monotonic() - t0
+                    return res
+            for ev in events:
+                if out_of_budget():
+                    res.truncated = True
+                    break
+                res.transitions += 1
+                w = replay(scenario, trace)
+                try:
+                    w.apply(ev, check=True)
+                except CheckFailure as e:
+                    res.violations.append(Violation(
+                        e.invariant, str(e), trace + (ev,)))
+                    if stop_on_first:
+                        res.elapsed_s = time.monotonic() - t0
+                        return res
+                    continue
+                except AssertionError as e:
+                    res.violations.append(Violation(
+                        "INTERNAL_ASSERT", str(e) or "assertion failed",
+                        trace + (ev,)))
+                    if stop_on_first:
+                        res.elapsed_s = time.monotonic() - t0
+                        return res
+                    continue
+                fp = fingerprint(w)
+                if fp in visited:
+                    continue
+                visited.add(fp)
+                res.states += 1
+                res.max_depth_reached = max(res.max_depth_reached,
+                                            len(trace) + 1)
+                frontier.append(trace + (ev,))
+                res.drains += 1
+                lv = _safe_drain(w, quiesce_cache)  # reuses w in place
+                if lv is not None:
+                    res.violations.append(lv)
+                    if stop_on_first:
+                        res.elapsed_s = time.monotonic() - t0
+                        return res
+    res.elapsed_s = time.monotonic() - t0
+    return res
+
+
+@dataclass
+class WalkResult:
+    trace: Tuple[tuple, ...]
+    log: Tuple[tuple, ...]
+    final_fingerprint: object
+    violation: Optional[Violation] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+def random_walk(scenario: Scenario, seed: int, steps: int = 64
+                ) -> WalkResult:
+    """One seeded random walk through the event space, invariants
+    checked at every step. Deterministic in (scenario, seed) — the
+    determinism-audit regression runs two and diffs their logs."""
+    rng = np.random.RandomState(seed)
+    with faults.arming(*scenario.mutations):
+        world = World(scenario)
+        violation = None
+        for _ in range(steps):
+            choices: List[tuple] = world.enabled_nontick()
+            choices.extend(("tick", rid) for rid in world.tickable())
+            if not choices:
+                break
+            ev = choices[int(rng.randint(len(choices)))]
+            if ev[0] == "tick":
+                ev = ("tick", ev[1], int(rng.randint(0, 256)))
+            try:
+                world.apply(ev, check=True)
+            except CheckFailure as e:
+                violation = Violation(e.invariant, str(e),
+                                      tuple(world.trace))
+                break
+            except AssertionError as e:
+                violation = Violation("INTERNAL_ASSERT",
+                                      str(e) or "assertion failed",
+                                      tuple(world.trace))
+                break
+        return WalkResult(
+            trace=tuple(world.trace),
+            log=tuple(world.log),
+            final_fingerprint=fingerprint(world),
+            violation=violation,
+        )

@@ -1,0 +1,146 @@
+"""Canonical state fingerprints: the model checker's visited-set key.
+
+Two worlds get the SAME fingerprint exactly when no enabled event can
+tell them apart — the soundness condition for BFS dedup. That means:
+
+- **Physical page identity is anonymized.** Pages are relabeled by
+  first appearance (slot order, then prefix-cache LRU order); the free
+  list contributes only its size. Permuting which physical pages are
+  free must not split states (test_fleetcheck asserts this).
+- **Host store keys are anonymized** the same way (keys are an
+  allocation counter — logically meaningless).
+- **Absolute time is dropped.** Only behavior-relevant RELATIVE times
+  survive: queue age vs the timeout, retry_after distance. The plan
+  tick counter is rank-normalized per replica (only the cold-victim
+  ORDERING of ``last_planned`` matters, never its absolute value).
+- **Progress meters, logs and metrics are excluded** — they grow
+  monotonically and would make every state unique. (The liveness pass
+  compares progress ACROSS visits of one fingerprint instead.)
+
+Everything that CAN change a successor is included: slot contents +
+page tables + host maps, queue order + ages, free-slot stack order,
+decode round-robin cursor, promotion focus, prefix cache LRU (both
+tiers) + pins, router cursor + session map, per-request lifecycle and
+the remaining event allowances (advances, resubmits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ...serving.request import RequestStatus
+
+__all__ = ["fingerprint"]
+
+
+def _rel(t: Optional[float], now: float) -> Optional[float]:
+    return None if t is None else round(t - now, 9)
+
+
+class _Canon:
+    """First-appearance relabeling for one id namespace."""
+
+    def __init__(self):
+        self._map: Dict[int, int] = {}
+
+    def __call__(self, raw: int) -> int:
+        if raw == -1:
+            return -1
+        return self._map.setdefault(raw, len(self._map))
+
+
+def _state_fp(world, st, now: float, cpage: _Canon, ckey: _Canon,
+              rank: Dict[int, int]):
+    return (
+        world.req_index(st),
+        st.status.value,
+        st.prompt_pos,
+        tuple(st.tokens),
+        tuple(st.draft_tail),
+        st.cached_tokens,
+        st.owned_from,
+        tuple(cpage(p) for p in st.pages),
+        tuple((li, ckey(k), owned)
+              for li, (k, owned) in sorted(st.host_pages.items())),
+        rank.get(st.last_planned, -1),
+        st.attempts,
+    )
+
+
+def _replica_fp(world, rep, now: float, ckey: _Canon):
+    sched = rep.engine.scheduler
+    cpage = _Canon()
+    # rank-normalize last_planned across this replica's live states:
+    # only the relative coldness ordering drives demotion victims
+    lp = sorted({
+        s.last_planned
+        for s in list(sched.slots) + list(sched.queue) if s is not None
+    })
+    rank = {v: i for i, v in enumerate(lp)}
+
+    slots = tuple(
+        None if s is None else
+        _state_fp(world, s, now, cpage, ckey, rank)
+        + (s.slot in sched._fresh,)
+        for s in sched.slots
+    )
+    queue = tuple(
+        (_state_fp(world, s, now, cpage, ckey, rank),
+         _rel(s.arrival_t, now))
+        for s in sched.queue
+    )
+    cache_fp = ()
+    if sched.prefix_cache is not None:
+        cache = sched.prefix_cache
+        cache_fp = (
+            tuple((kind, h, cpage(page), toks)
+                  for (kind, h, page, toks) in cache._lru),
+            tuple((h, ckey(cache._host_full[h][0]))
+                  for h in cache._host_lru),
+            tuple(sorted((ckey(k), n)
+                         for k, n in cache._host_pins.items())),
+        )
+    store = world.stores[rep.replica_id]
+    store_fp = () if store is None else (
+        store.host_count, store.disk_count,
+        tuple(sorted((ckey(k), owned)
+                     for k, owned in sched._inflight.items())),
+    )
+    return (
+        slots,
+        queue,
+        tuple(sched._free),
+        sched._decode_rr,
+        sched._promote_focus,
+        sched.pool.free_count if sched.paged else None,
+        cache_fp,
+        store_fp,
+    )
+
+
+def fingerprint(world):
+    """Hashable canonical fingerprint of a :class:`World`."""
+    now = world.clock()
+    ckey = _Canon()  # host keys are per-replica stores, but a single
+    #   first-appearance namespace keeps the relabeling deterministic
+    reps = tuple(
+        _replica_fp(world, rep, now, ckey) for rep in world.replicas
+    )
+    requests = tuple(
+        (None if st is None else (
+            st.status.value,
+            st.attempts,
+            world.resubmits[i],
+            _rel(st.retry_after, now)
+            if st.status is RequestStatus.EVICTED else None,
+            len(st.tokens),
+        ))
+        for i, st in enumerate(world.states)
+    )
+    router_fp = None
+    if world.router is not None:
+        router_fp = (
+            world.router._rr,
+            tuple(sorted(world.router._sessions.items())),
+        )
+    return (reps, requests, router_fp, world.n_advances)

@@ -1,0 +1,300 @@
+"""fleetcheck scenarios: small, exhaustively-explorable host-plane configs.
+
+A :class:`Scenario` is the complete, deterministic description of one
+bounded model-checking run: the host-plane configuration (slots, pages,
+tiers, replicas), the request population, the exploration bounds, and —
+for the seeded-bug smokes — the faults to arm (serving/faults.py).
+
+Presets (the CLI surface, mirroring shardlint's rule families):
+
+- ``oversubscription`` — 4 slots x 4 pages over an 8-page pool with a
+  host tier: the PR 18 promotion-liveness shape.
+- ``disaggregated_handoff`` — 1 prefill + 1 decode replica with a
+  page-scarce decode pool: handoff success, deferral and rollback.
+- ``tiered_cold_resume`` — prefix cache + host tier under LRU pressure:
+  chains demote to host and a later identical prompt cold-resumes
+  through the promotion path.
+- ``spec_on`` — speculative decoding with a repetition-penalized
+  request riding along (the seen-matrix bypass discipline, H7).
+- ``fleet_shedding`` — 2 mixed replicas behind a fleet-level bounded
+  queue: sheds, backoff hints, resubmission.
+
+``MUTATIONS`` maps each seeded-bug smoke to (base scenario builder,
+faults to arm, the invariant/liveness id the checker MUST report). The
+clean twin of each mutant is the same scenario with no faults armed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "RequestSpec", "Scenario", "PRESETS", "MUTATIONS", "Mutation",
+    "preset",
+]
+
+
+@dataclass(frozen=True)
+class RequestSpec:
+    """One abstract request in a scenario's population."""
+
+    prompt: Tuple[int, ...]
+    max_new: int = 2
+    penalty: float = 1.0          # repetition_penalty (1.0 = off)
+    session: Optional[str] = None  # fleet session affinity key
+
+
+@dataclass
+class Scenario:
+    """One bounded model-checking run, fully deterministic."""
+
+    name: str
+    requests: Tuple[RequestSpec, ...]
+    # ---- per-replica scheduler config (uniform unless decode_* set)
+    max_slots: int = 2
+    token_budget: int = 8
+    queue_limit: int = 8
+    request_timeout_s: float = 1e9
+    eviction_backoff_s: float = 1.0
+    max_tokens: int = 64
+    page_size: int = 2
+    num_pages: int = 4
+    pages_per_slot: int = 2
+    host_pages: int = 0           # 0 = no host tier (no spiller)
+    prefix_cache: bool = False
+    spec_max_draft: int = 0
+    # ---- fleet shape (replicas == 1 -> no router, plain scheduler)
+    replicas: int = 1
+    prefill_replicas: int = 0
+    fleet_queue_limit: int = 0
+    routing: str = "least_loaded"
+    affinity: bool = True
+    decode_max_slots: Optional[int] = None   # decode-role overrides
+    decode_num_pages: Optional[int] = None
+    # ---- event alphabet bounds
+    advance_dts: Tuple[float, ...] = (2.0,)  # clock jumps on "advance"
+    max_advances: int = 2
+    max_resubmits: int = 1        # resubmissions per request
+    # ---- exploration bounds
+    max_depth: int = 12
+    max_states: int = 2000
+    drain_horizon: int = 32       # liveness: ticks to reach quiescence
+    budget_s: float = 60.0        # wall-clock bound per explore() call
+    # ---- seeded bugs (serving/faults.py names) armed for the run
+    mutations: Tuple[str, ...] = ()
+    # ---- token alphabet of the null device
+    eos_token: int = 1
+    tok_token: int = 7
+
+    def describe(self) -> str:
+        fleet = (f", {self.replicas} replicas"
+                 f" ({self.prefill_replicas} prefill)"
+                 if self.replicas > 1 else "")
+        tier = f", host={self.host_pages}" if self.host_pages else ""
+        mut = f", mutations={list(self.mutations)}" if self.mutations \
+            else ""
+        return (f"{self.name}: {len(self.requests)} requests, "
+                f"{self.max_slots} slots, {self.num_pages} pages"
+                f"{tier}{fleet}{mut}")
+
+
+def _prompts(n: int, length: int, base: int = 11) -> Tuple[RequestSpec, ...]:
+    """``n`` distinct prompts of ``length`` tokens (token ids avoid the
+    scenario's eos/tok alphabet so nothing terminates by accident)."""
+    return tuple(
+        RequestSpec(prompt=tuple(base + i for _ in range(length)),
+                    max_new=2)
+        for i in range(n)
+    )
+
+
+# --------------------------------------------------------------- presets
+def oversubscription() -> Scenario:
+    """The PR 18 shape: 4 slots of up to 4 pages over an 8-page pool
+    with a host tier. Demotions, promotions, starvation evictions and
+    the promotion-liveness argument all exercise here."""
+    reqs = tuple(
+        RequestSpec(prompt=tuple(20 + i for _ in range(5)), max_new=3)
+        for i in range(4)
+    )
+    return Scenario(
+        name="oversubscription",
+        requests=reqs,
+        max_slots=4, token_budget=4, queue_limit=8,
+        page_size=2, num_pages=8, pages_per_slot=4, host_pages=8,
+        max_tokens=8,
+        # ~51k reachable states to depth 11: exhaustive in ~90s on one
+        # CPU core (the CI budget); tier-1 tests shrink max_states
+        max_depth=11, max_states=60000, drain_horizon=40,
+        budget_s=150.0,
+    )
+
+
+def disaggregated_handoff() -> Scenario:
+    """1 prefill + 1 decode replica; the decode pool is page-scarce so
+    handoffs both succeed and defer (rollback path) in-bounds."""
+    reqs = tuple(
+        RequestSpec(prompt=tuple(30 + i for _ in range(3)), max_new=2)
+        for i in range(3)
+    )
+    return Scenario(
+        name="disaggregated_handoff",
+        requests=reqs,
+        max_slots=2, token_budget=6, queue_limit=8,
+        page_size=2, num_pages=6, pages_per_slot=3, host_pages=0,
+        max_tokens=6,
+        replicas=2, prefill_replicas=1,
+        decode_max_slots=2, decode_num_pages=3,
+        max_depth=10, max_states=4000, drain_horizon=32,
+    )
+
+
+def tiered_cold_resume() -> Scenario:
+    """Prefix cache + host tier under LRU pressure: a finished request's
+    chain demotes to host, and an identical later prompt cold-resumes
+    through host_chain attach + promotion staging."""
+    shared = tuple(40 for _ in range(6))
+    reqs = (
+        RequestSpec(prompt=shared, max_new=2),
+        RequestSpec(prompt=tuple(50 for _ in range(4)), max_new=2),
+        RequestSpec(prompt=shared, max_new=2),
+    )
+    return Scenario(
+        name="tiered_cold_resume",
+        requests=reqs,
+        max_slots=2, token_budget=6, queue_limit=8,
+        page_size=2, num_pages=5, pages_per_slot=4, host_pages=6,
+        max_tokens=8, prefix_cache=True,
+        max_depth=11, max_states=2500, drain_horizon=32,
+    )
+
+
+def spec_on() -> Scenario:
+    """Speculative decoding on, one repetition-penalized request in the
+    mix: the penalized request must bypass drafts AND the prefix cache
+    (H7) while the others draft freely."""
+    reqs = (
+        RequestSpec(prompt=(60, 60, 60), max_new=3),
+        RequestSpec(prompt=(60, 60, 60), max_new=3, penalty=1.2),
+        RequestSpec(prompt=(61, 61, 61), max_new=2),
+    )
+    return Scenario(
+        name="spec_on",
+        requests=reqs,
+        max_slots=2, token_budget=6, queue_limit=8,
+        page_size=2, num_pages=6, pages_per_slot=3, host_pages=0,
+        max_tokens=6, prefix_cache=True, spec_max_draft=1,
+        max_depth=10, max_states=6000, drain_horizon=32,
+    )
+
+
+def fleet_shedding() -> Scenario:
+    """2 mixed replicas behind a tight fleet-wide queue bound: sheds,
+    per-replica bounded queues, backoff monotonicity, resubmission."""
+    reqs = tuple(
+        RequestSpec(prompt=tuple(70 + i for _ in range(3)), max_new=2,
+                    session=("s0" if i % 2 == 0 else None))
+        for i in range(4)
+    )
+    return Scenario(
+        name="fleet_shedding",
+        requests=reqs,
+        max_slots=1, token_budget=6, queue_limit=1,
+        page_size=2, num_pages=3, pages_per_slot=3, host_pages=0,
+        max_tokens=6,
+        replicas=2, prefill_replicas=0, fleet_queue_limit=2,
+        routing="least_loaded",
+        max_depth=10, max_states=15000, drain_horizon=32,
+        max_resubmits=1,
+    )
+
+
+PRESETS: Dict[str, Callable[[], Scenario]] = {
+    "oversubscription": oversubscription,
+    "disaggregated_handoff": disaggregated_handoff,
+    "tiered_cold_resume": tiered_cold_resume,
+    "spec_on": spec_on,
+    "fleet_shedding": fleet_shedding,
+}
+
+
+def preset(name: str) -> Scenario:
+    if name not in PRESETS:
+        raise KeyError(
+            f"unknown fleetcheck preset {name!r} (known: {sorted(PRESETS)})"
+        )
+    return PRESETS[name]()
+
+
+# ---------------------------------------------------------- seeded bugs
+@dataclass(frozen=True)
+class Mutation:
+    """One seeded-bug smoke: base scenario + armed faults + what the
+    checker MUST report (the paritycheck --mutate contract)."""
+
+    name: str
+    base: Callable[[], Scenario]
+    faults: Tuple[str, ...]
+    expect: str        # violation id fleetcheck must name
+    detail: str
+
+    def scenario(self) -> Scenario:
+        sc = self.base()
+        return replace(
+            sc,
+            name=f"{sc.name}+{'+'.join(self.faults)}",
+            mutations=tuple(self.faults),
+        )
+
+    def clean(self) -> Scenario:
+        return self.base()
+
+
+def _livelock_base() -> Scenario:
+    """The promotion-livelock shape: one short decode hog plus four
+    long prompts over a pool that holds barely one of them
+    (page_size=1 so every written page is demotable). The hog's decode
+    allocations demote the mid-prefill slots; once all four wait on
+    promotions, the unsticky planner's promote-2/steal-2 rotation
+    never brings any slot back to full residency — a zero-progress
+    cycle with no samplers, so even the all-EOS drain policy cannot
+    break it. The sticky planner heals one waiter to residency per
+    ceil(n/STAGE_SLOTS) ticks and quiesces."""
+    reqs = (RequestSpec(prompt=(9,), max_new=6),) + tuple(
+        RequestSpec(prompt=tuple(20 + i for _ in range(7)), max_new=1)
+        for i in range(4)
+    )
+    return Scenario(
+        name="promotion_liveness",
+        requests=reqs,
+        max_slots=4, token_budget=2, queue_limit=8,
+        page_size=1, num_pages=8, pages_per_slot=8, host_pages=24,
+        max_tokens=8,
+        max_advances=0, max_resubmits=0,
+        # the mutant's counterexample sits at depth 7 (5 submits + 2
+        # ticks); depth 8 keeps the clean twin exhaustively explorable
+        max_depth=8, max_states=8000, drain_horizon=60,
+        budget_s=60.0,
+    )
+
+
+MUTATIONS: Dict[str, Mutation] = {
+    "promotion_livelock": Mutation(
+        name="promotion_livelock",
+        base=_livelock_base,
+        faults=("promotion_unsticky",),
+        expect="LIVELOCK",
+        detail="PR 18 promotion livelock: stickiness guard off — "
+               "fleetcheck must report a zero-progress cycle",
+    ),
+    "handoff_leak": Mutation(
+        name="handoff_leak",
+        base=disaggregated_handoff,
+        faults=("handoff_leak",),
+        expect="H3",
+        detail="handoff rollback skips freeing dst pages on a deferred "
+               "transfer — fleetcheck must pin the refcount/conservation "
+               "invariant",
+    ),
+}

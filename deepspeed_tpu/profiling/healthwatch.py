@@ -165,6 +165,10 @@ DEFAULT_RULES: Dict[str, Dict[str, Any]] = {
         "enabled": True, "severity": "warn", "action": "log",
         "p95_s": None, "window": 32,
     },
+    "zero_progress": {
+        "enabled": True, "severity": "critical", "action": "log",
+        "window": 16,
+    },
 }
 
 
@@ -383,6 +387,10 @@ class HealthWatch:
         self._prediction: Optional[Dict[str, Any]] = None
         self._comm_est_s = 0.0
         self._prev_fired: set = set()
+        # zero_progress watchdog: token counter at the last serve tick
+        # and the current length of the no-progress streak
+        self._zp_last_tokens: Optional[int] = None
+        self._zp_streak = 0
         self.exporter: Optional[MetricsExporter] = None
         export_path = _cfg_get(config, "export_path", None)
         if export_path:
@@ -667,6 +675,33 @@ class HealthWatch:
                 elif ttft_p95 is not None:
                     self._eval(evals, "ttft_breach", round(ttft_p95, 6),
                                float(r["p95_s"]), False)
+            r = self._rule("zero_progress")
+            if r:
+                # livelock watchdog (the runtime twin of fleetcheck's
+                # LIVELOCK oracle, docs/modelcheck.md): occupied slots
+                # whose cumulative token counters — emitted AND
+                # scheduled, so a long prefill is progress — freeze for
+                # a whole window of consecutive serve ticks
+                tokens = (int(getattr(metrics, "tokens_out", 0))
+                          + int(getattr(metrics, "scheduled_tokens", 0)))
+                occupancy = float(
+                    getattr(metrics, "slot_occupancy", 0.0)
+                )
+                stalled = (self._zp_last_tokens is not None
+                           and tokens == self._zp_last_tokens
+                           and occupancy > 0.0)
+                self._zp_last_tokens = tokens
+                self._zp_streak = self._zp_streak + 1 if stalled else 0
+                window = int(r.get("window", 16))
+                if self._zp_streak >= window:
+                    fire("zero_progress", r, self._zp_streak, window,
+                         f"{self._zp_streak} consecutive serve ticks "
+                         f"with occupied slots and zero token progress "
+                         f"(scheduler livelock suspect)")
+                    self._zp_streak = 0  # re-arm: fire once per window
+                else:
+                    self._eval(evals, "zero_progress", self._zp_streak,
+                               window, False)
         self._eval_timing_rules(step_s, compiled, step, evals, fire)
         return self._finish_step(step, step_s, spans, evals, fired, {
             "queue_depth": queue_depth,

@@ -1,0 +1,81 @@
+"""Test-only fault injection seams for the host control plane.
+
+The fleetcheck model checker (analysis/modelcheck/) proves the
+scheduler/paging/fleet invariants over small exhaustive state spaces —
+but a prover is only trustworthy if it FINDS bugs when they exist. This
+module is the seeded-bug corpus seam (the paritycheck ``--mutate``
+pattern lifted to the host plane): production code consults
+:func:`armed` at the exact sites where a historical (or representative)
+bug lived, and re-introduces the bug ONLY while a test/CLI has armed it.
+
+Nothing here is reachable from configuration; the armed set is
+process-local, empty by default, and every consumer treats "not armed"
+as the zero-cost fast path (one set-membership test).
+
+Known faults
+------------
+``promotion_unsticky``
+    Re-introduces the PR 18 promotion livelock: the tiered-KV promotion
+    planner loses its stickiness guard — no sticky ``_promote_focus``,
+    and promotion allocations run with ``stalled_only=False`` so feeding
+    a waiter may demote a resident (runnable) slot. Under
+    oversubscription (4 slots x 4 pages over an 8-page pool) the fleet
+    thrashes pages in and out every tick with zero tokens emitted.
+
+``handoff_leak``
+    Breaks the prefill->decode handoff rollback contract: destination
+    pages are allocated one-by-one straight from the pool and NOT
+    returned on a deferred transfer — a failed handoff leaks refcount-1
+    pages that no slot or cache references (and skips the page-invariant
+    asserts that would catch it locally, which is exactly why fleetcheck
+    must catch it checker-side).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import FrozenSet, Iterator, Set
+
+KNOWN_FAULTS: FrozenSet[str] = frozenset({
+    "promotion_unsticky",
+    "handoff_leak",
+})
+
+_ARMED: Set[str] = set()
+
+
+def armed(name: str) -> bool:
+    """Is fault ``name`` currently armed? (The production-path check —
+    one set lookup, False unless a test armed it.)"""
+    return name in _ARMED
+
+
+def arm(name: str) -> None:
+    if name not in KNOWN_FAULTS:
+        raise ValueError(
+            f"unknown fault {name!r} (known: {sorted(KNOWN_FAULTS)})"
+        )
+    _ARMED.add(name)
+
+
+def disarm(name: str) -> None:
+    _ARMED.discard(name)
+
+
+def disarm_all() -> None:
+    _ARMED.clear()
+
+
+@contextmanager
+def arming(*names: str) -> Iterator[None]:
+    """Arm ``names`` for the duration of a with-block, restoring the
+    previous armed set on exit (exception-safe — a failing check must
+    not leak an armed fault into later tests)."""
+    prev = set(_ARMED)
+    try:
+        for n in names:
+            arm(n)
+        yield
+    finally:
+        _ARMED.clear()
+        _ARMED.update(prev)

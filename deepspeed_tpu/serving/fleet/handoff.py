@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import faults
 from ..request import RequestState
 from .replica import ReplicaHandle
 
@@ -59,15 +60,28 @@ def handoff(state: RequestState, src: ReplicaHandle, dst: ReplicaHandle,
     need = pages_needed(state, src_sched.page_size)
     if not dst_sched._free:
         return None
-    dst_pages = dst_sched.alloc_pages(need)
-    if dst_pages is None:
-        # destination pool exhausted even after LRU eviction: defer.
-        # alloc_pages already rolled its partial allocation back, so the
-        # invariant holds on both sides — assert it anyway (the leak
-        # test forces exactly this path).
-        src_sched.assert_page_invariants()
-        dst_sched.assert_page_invariants()
-        return None
+    if faults.armed("handoff_leak"):
+        # seeded-bug seam (serving/faults.py): the broken rollback twin
+        # fleetcheck's --mutate smoke must catch — pages allocated
+        # one-by-one and NOT returned on a deferred transfer, with the
+        # local invariant asserts skipped (the leak is only visible to
+        # a checker-side conservation test). Never armed outside tests.
+        dst_pages = []
+        for _ in range(need):
+            p = dst_sched.pool.alloc()
+            if p is None:
+                return None  # leaks every page in dst_pages (refcount 1)
+            dst_pages.append(p)
+    else:
+        dst_pages = dst_sched.alloc_pages(need)
+        if dst_pages is None:
+            # destination pool exhausted even after LRU eviction: defer.
+            # alloc_pages already rolled its partial allocation back, so
+            # the invariant holds on both sides — assert it anyway (the
+            # leak test forces exactly this path).
+            src_sched.assert_page_invariants()
+            dst_sched.assert_page_invariants()
+            return None
 
     # payload snapshot BEFORE the src release: the physical ids are about
     # to be decref'd (release may free them into the src pool)

@@ -496,9 +496,11 @@ class FleetMetrics:
     sum across replicas; latency percentiles merge the per-replica sample
     lists (a request's TTFT is a fleet-level fact — it does not matter
     which replica served it); gauges that are depths sum, ratios average
-    over replicas. Duck-types the two attributes the healthwatch serving
-    watchdogs read (``queue_depth``, ``ttft_s``), so the queue/TTFT rules
-    evaluate FLEET-wide when the router owns the healthwatch.
+    over replicas. Duck-types the attributes the healthwatch serving
+    watchdogs read (``queue_depth``, ``ttft_s``, and the zero_progress
+    trio ``tokens_out``/``scheduled_tokens``/``slot_occupancy``), so
+    the queue/TTFT/livelock rules evaluate FLEET-wide when the router
+    owns the healthwatch.
 
     Exported under the ``serve/fleet/*`` namespace (per-replica metrics
     keep ``serve/*`` on their own engines) — docs/observability.md."""
@@ -580,6 +582,26 @@ class FleetMetrics:
         live in :meth:`snapshot`, which merges the full per-replica
         lists."""
         return list(self.recent_ttft_s)
+
+    @property
+    def tokens_out(self) -> int:
+        """Fleet-wide emitted tokens (zero_progress watchdog input)."""
+        return sum(int(m.tokens_out) for m in self.replicas)
+
+    @property
+    def scheduled_tokens(self) -> int:
+        """Fleet-wide scheduled tokens — prefill chunks count as
+        progress for the zero_progress watchdog even before a request's
+        first sampled token."""
+        return sum(int(m.scheduled_tokens) for m in self.replicas)
+
+    @property
+    def slot_occupancy(self) -> float:
+        """Mean slot occupancy across replicas: the zero_progress
+        watchdog only treats frozen counters as a stall while work is
+        actually slotted somewhere."""
+        return (sum(float(m.slot_occupancy) for m in self.replicas)
+                / max(len(self.replicas), 1))
 
     # ------------------------------------------------------ reporting
     @property

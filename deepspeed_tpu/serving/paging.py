@@ -367,8 +367,12 @@ class HostPageStore:
     def __contains__(self, key: int) -> bool:
         return key in self._blobs or key in self._disk
 
-    def keys(self):
-        return set(self._blobs) | set(self._disk)
+    def keys(self) -> List[int]:
+        """Every resident key (host + NVMe tiers), SORTED — iteration
+        over the store must be order-deterministic so state fingerprints
+        (analysis/modelcheck) and counterexample replays are stable
+        across runs."""
+        return sorted(set(self._blobs) | set(self._disk))
 
     @property
     def host_count(self) -> int:

@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..utils.logging import log_dist
+from . import faults
 from .paging import STAGE_SLOTS, PagePool, PrefixCache
 from .request import Request, RequestState, RequestStatus
 from .spec import propose_drafts
@@ -594,11 +595,19 @@ class Scheduler:
         stage: List[StagedPage] = []
         if self.spiller is None:
             return stage
+        # seeded-bug seam (serving/faults.py): fleetcheck's --mutate
+        # smoke re-introduces the pre-guard planner — no stickiness, and
+        # waiter feeds may demote resident slots — to prove the checker
+        # finds the PR 18 livelock. (Warming stays on: it is exactly
+        # what rotates the unsticky planner's focus, so each waiter gets
+        # STAGE_SLOTS pages and then yields before reaching residency.)
+        # Never armed outside tests.
+        sticky = not faults.armed("promotion_unsticky")
         waiting = sorted(
             (s for s in self.slots if s is not None and s.host_pages),
             key=lambda s: (s.last_planned, s.slot),
         )
-        if self._promote_focus is not None:
+        if self._promote_focus is not None and sticky:
             focus = next(
                 (s for s in waiting if s.slot == self._promote_focus), None
             )
@@ -615,7 +624,8 @@ class Scheduler:
             for li in sorted(state.host_pages):
                 if len(stage) >= STAGE_SLOTS:
                     break
-                dst = self._alloc_page(protect=(state,), stalled_only=True)
+                dst = self._alloc_page(protect=(state,),
+                                       stalled_only=sticky)
                 if dst is None:
                     break  # pool bound even after demotions: wait a tick
                 key, owned = state.host_pages.pop(li)
@@ -631,11 +641,12 @@ class Scheduler:
                 # covers THIS tick)
                 state.last_planned = self._ticks
                 if state.host_pages:
-                    # sticky: keep filling THIS slot next tick until it
-                    # is fully resident
-                    self._promote_focus = state.slot
-                    break
-                if state.slot == self._promote_focus:
+                    if sticky:
+                        # sticky: keep filling THIS slot next tick until
+                        # it is fully resident
+                        self._promote_focus = state.slot
+                        break
+                elif state.slot == self._promote_focus:
                     self._promote_focus = None
         return stage
 

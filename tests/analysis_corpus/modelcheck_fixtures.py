@@ -1,0 +1,59 @@
+"""Seeded-bug fixtures for the fleetcheck corpus.
+
+Every builder returns ``(scenario, expect)`` — a fully-specified model
+checking run and the violation id fleetcheck MUST report on it
+(``None`` for the clean twins, which must come back green). The armed
+scenarios carry their fault names in ``scenario.mutations``; the
+faults themselves live behind test-only flags in serving/faults.py and
+are compiled out of any run that does not arm them.
+
+- ``promotion_livelock``       LIVELOCK: the PR 18 promotion planner
+  with the stickiness guard removed (``promotion_unsticky``) — the
+  promote-2/steal-2 rotation never returns any waiter to full
+  residency, a zero-progress cycle the all-EOS drain cannot break
+- ``promotion_livelock_clean`` the same scenario unarmed: the sticky
+  planner heals one waiter per ceil(n/STAGE_SLOTS) ticks and every
+  state quiesces
+- ``handoff_leak``             H3: fleet handoff rollback that drops
+  its dst-page cleanup on a deferred transfer (``handoff_leak``) —
+  refcount-1 pages with no holder, pinned by the conservation sweep
+- ``handoff_leak_clean``       the same prefill/decode split unarmed
+
+These are the regression anchors for docs/modelcheck.md "seeded-bug
+corpus": if a refactor makes any armed fixture come back clean, the
+checker (or the fault seam) lost its teeth — fail the build, don't
+relax the fixture.
+"""
+
+from deepspeed_tpu.analysis.modelcheck import MUTATIONS
+
+__all__ = [
+    "promotion_livelock", "promotion_livelock_clean",
+    "handoff_leak", "handoff_leak_clean", "ALL",
+]
+
+
+def promotion_livelock():
+    mut = MUTATIONS["promotion_livelock"]
+    return mut.scenario(), mut.expect
+
+
+def promotion_livelock_clean():
+    return MUTATIONS["promotion_livelock"].clean(), None
+
+
+def handoff_leak():
+    mut = MUTATIONS["handoff_leak"]
+    return mut.scenario(), mut.expect
+
+
+def handoff_leak_clean():
+    return MUTATIONS["handoff_leak"].clean(), None
+
+
+ALL = {
+    "promotion_livelock": promotion_livelock,
+    "promotion_livelock_clean": promotion_livelock_clean,
+    "handoff_leak": handoff_leak,
+    "handoff_leak_clean": handoff_leak_clean,
+}

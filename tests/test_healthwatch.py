@@ -27,7 +27,8 @@ from deepspeed_tpu.models import llama
 from deepspeed_tpu.profiling import healthwatch, steptrace
 from deepspeed_tpu.profiling.healthwatch import HealthWatch, MetricsExporter
 from deepspeed_tpu.serving import Request, ServingEngine
-from deepspeed_tpu.serving.metrics import (ServingMetrics, percentile,
+from deepspeed_tpu.serving.metrics import (FleetMetrics, ServingMetrics,
+                                           percentile,
                                            recent_percentile)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -88,12 +89,12 @@ class FakeClock:
         self.t += dt
 
 
-def synthetic_hw(rules=None, **over):
+def synthetic_hw(rules=None, source="train", **over):
     cfg = {"enabled": True, "ring_steps": over.pop("ring_steps", 32),
            "install_signal_handler": False,
            "rules": rules or {}, **over}
     clk = FakeClock()
-    return HealthWatch(cfg, None, source="train", clock=clk), clk
+    return HealthWatch(cfg, None, source=source, clock=clk), clk
 
 
 # ---------------------------------------------------------------------------
@@ -558,3 +559,91 @@ def test_train_mfu_reaches_registry():
     mfu = [v for t, v, _s, _t in reg.samples if t == "train/mfu"]
     assert all(0.0 <= v for v in mfu) and math.isfinite(mfu[-1])
     engine.destroy()
+
+
+# ---------------------------------------------------------------------------
+# serving: zero_progress livelock watchdog (the runtime twin of
+# fleetcheck's LIVELOCK oracle — docs/modelcheck.md)
+# ---------------------------------------------------------------------------
+class _ServeMetrics:
+    """Duck-typed metrics carrying exactly what on_serve_step reads."""
+
+    def __init__(self):
+        self.queue_depth = 0
+        self.ttft_s = []
+        self.tokens_out = 0
+        self.scheduled_tokens = 0
+        self.slot_occupancy = 1.0
+
+
+def test_zero_progress_watchdog_on_fake_clock():
+    hw, clk = synthetic_hw(
+        rules={"zero_progress": {"window": 4}}, source="serve")
+    m = _ServeMetrics()
+    # progressing ticks: counters move -> streak never builds
+    for step in range(6):
+        m.tokens_out += 2
+        hw.on_serve_step(step, metrics=m)
+        clk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) == 0
+    # prefill-only progress (scheduled but nothing emitted yet) is
+    # still progress: no fire
+    for step in range(6, 10):
+        m.scheduled_tokens += 4
+        hw.on_serve_step(step, metrics=m)
+        clk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) == 0
+    # frozen counters with occupied slots: fires once per full window
+    for step in range(10, 19):
+        hw.on_serve_step(step, metrics=m)
+        clk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) == 2  # 8 stalls, w=4
+    ev = next(e for e in hw.events if e["rule"] == "zero_progress")
+    assert "livelock" in ev["detail"]
+    assert ev["value"] == 4 and ev["threshold"] == 4
+
+
+def test_zero_progress_ignores_idle_and_rearms():
+    hw, clk = synthetic_hw(
+        rules={"zero_progress": {"window": 3}}, source="serve")
+    m = _ServeMetrics()
+    m.slot_occupancy = 0.0
+    # idle fleet: frozen counters with NO slotted work is not a stall
+    for step in range(8):
+        hw.on_serve_step(step, metrics=m)
+        clk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) == 0
+    # work appears and wedges -> fire; progress resumes -> streak drops
+    m.slot_occupancy = 0.5
+    for step in range(8, 12):
+        hw.on_serve_step(step, metrics=m)
+        clk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) == 1
+    m.tokens_out += 1
+    hw.on_serve_step(12, metrics=m)
+    for step in range(13, 15):
+        hw.on_serve_step(step, metrics=m)
+        clk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) == 1  # streak restarted
+
+
+def test_zero_progress_reads_fleet_metrics_ducktype():
+    # FleetMetrics aggregates the zero_progress trio across replicas;
+    # the watchdog must see fleet-wide freeze, not per-replica noise
+    m0, m1 = ServingMetrics(), ServingMetrics()
+    clk = FakeClock()
+    fleet = FleetMetrics([m0, m1], clock=clk)
+    assert fleet.tokens_out == 0 and fleet.scheduled_tokens == 0
+    m0.tokens_out, m1.tokens_out = 3, 4
+    m0.scheduled_tokens, m1.scheduled_tokens = 10, 0
+    m0.slot_occupancy, m1.slot_occupancy = 1.0, 0.0
+    assert fleet.tokens_out == 7
+    assert fleet.scheduled_tokens == 10
+    assert fleet.slot_occupancy == 0.5
+
+    hw, hclk = synthetic_hw(
+        rules={"zero_progress": {"window": 2}}, source="serve")
+    for step in range(4):
+        hw.on_serve_step(step, metrics=fleet)
+        hclk.advance(0.01)
+    assert hw.counters.get("zero_progress", 0) >= 1

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""fleetcheck CLI: exhaustive host-plane model checking.
+
+    python tools/fleetcheck.py --all-presets
+    python tools/fleetcheck.py --preset oversubscription
+    python tools/fleetcheck.py --all-presets --json /tmp/fleetcheck.json
+    python tools/fleetcheck.py --mutate promotion_livelock
+    python tools/fleetcheck.py --mutate all
+
+Drives the REAL host-plane objects (Scheduler, PagePool, PrefixCache,
+PageSpiller/HostPageStore, fleet Router) through every interleaving of
+an abstract event alphabet — submit, tick with each per-slot sampling
+outcome, clock advance, handoff, resubmit — over small configs, on a
+fake clock with a null device engine. Safety invariants H1-H7 (page
+conservation, tier exclusivity, placement, backoff monotonicity, the
+penalized-request discipline) are re-derived from first principles at
+every state, and every state is additionally DRAINED under an all-EOS
+policy to prove it quiesces: a fingerprint recurrence at equal token
+progress is reported as a LIVELOCK with the full replayable trace.
+
+Exit 1 on any violation, naming the invariant and printing the minimal
+(BFS-order) event trace. Exit 1 also on a vacuous run (nothing
+explored) so a typo'd preset filter cannot green the gate.
+
+``--mutate`` is the seeded-bug smoke (wired into CI): the named entry
+from MUTATIONS re-runs its scenario with a test-only fault armed
+(serving/faults.py) — the PR 18 promotion livelock (stickiness guard
+off) or the handoff rollback leak — and the run must FAIL (exit 1)
+naming the expected invariant; CI asserts the exit code and greps the
+name. ``--clean-twin`` runs the same scenario UNARMED and must exit 0,
+proving the finding is the fault's and not the scenario's. A --mutate
+run that exits 0 means the checker lost its teeth.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_DIR not in sys.path:
+    sys.path.insert(0, REPO_DIR)
+
+
+def _run_one(scenario, args):
+    from deepspeed_tpu.analysis.modelcheck import explore
+
+    t0 = time.time()
+    res = explore(scenario, stop_on_first=not args.keep_going)
+    print(res.format())
+    if time.time() - t0 > args.budget_s:
+        print(f"fleetcheck: BUDGET {scenario.name}: "
+              f"{time.time() - t0:.1f}s > {args.budget_s:.0f}s")
+        return res, False
+    return res, res.ok
+
+
+def _run_mutation(name, args, clean_twin=False):
+    """One seeded-bug smoke half. Armed (``--mutate``): the checker is
+    expected to report ``mut.expect``, so the process exits 1 — CI
+    asserts the exit code and greps the invariant name. Unarmed
+    (``--clean-twin``): same scenario, no fault, must exit 0."""
+    from deepspeed_tpu.analysis.modelcheck import MUTATIONS, explore
+
+    mut = MUTATIONS[name]
+    t0 = time.time()
+    res = explore(mut.clean() if clean_twin else mut.scenario(),
+                  stop_on_first=not args.keep_going)
+    print(res.format())
+    if clean_twin:
+        ok = res.ok
+        print(f"fleetcheck: CLEAN-TWIN {name}: "
+              + ("green" if ok else "FAILED — the scenario is broken, "
+                                    "not the mutant")
+              + f" ({res.states} states, {time.time() - t0:.1f}s)")
+        return res, ok
+    found = [v.invariant for v in res.violations]
+    if mut.expect not in found:
+        print(f"fleetcheck: MUTATE {name}: expected {mut.expect}, got "
+              f"{found or 'a clean run'} — the checker lost its teeth")
+    else:
+        print(f"fleetcheck: MUTATE {name}: caught {mut.expect} in "
+              f"{time.time() - t0:.1f}s (exit 1 is the required "
+              f"outcome here)")
+    return res, res.ok  # armed: violations make the process exit 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleetcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--preset", action="append", default=[],
+                    metavar="NAME", help="run one named preset "
+                    "(repeatable; see --list)")
+    ap.add_argument("--all-presets", action="store_true",
+                    help="run every shipped preset scenario")
+    ap.add_argument("--list", action="store_true",
+                    help="list presets and mutations, then exit")
+    ap.add_argument("--mutate", action="append", default=[],
+                    metavar="NAME",
+                    help="seeded-bug smoke: run MUTATIONS[NAME] with "
+                         "its fault armed — MUST exit 1 naming the "
+                         "expected invariant; 'all' for every mutation")
+    ap.add_argument("--clean-twin", action="append", default=[],
+                    metavar="NAME",
+                    help="run MUTATIONS[NAME] unarmed — must exit 0; "
+                         "'all' for every mutation")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write machine-readable results here "
+                         "('-' for stdout)")
+    ap.add_argument("--budget-s", type=float, default=240.0,
+                    help="per-scenario wall-clock budget (seconds)")
+    ap.add_argument("--keep-going", action="store_true",
+                    help="collect every violation instead of stopping "
+                         "at the first")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.analysis.modelcheck import MUTATIONS, PRESETS, preset
+
+    if args.list:
+        for name in PRESETS:
+            print(f"preset   {name}: {PRESETS[name]().describe()}")
+        for name, mut in MUTATIONS.items():
+            print(f"mutation {name}: expects {mut.expect} — {mut.detail}")
+        return 0
+    if not (args.preset or args.all_presets or args.mutate
+            or args.clean_twin):
+        ap.error("no targets: pass --preset/--all-presets, --mutate "
+                 "and/or --clean-twin")
+
+    # the scheduler narrates evictions at INFO; the checker's traces
+    # already carry that story
+    logging.getLogger("deepspeed_tpu").setLevel(logging.WARNING)
+
+    names = list(args.preset)
+    if args.all_presets:
+        names += [n for n in PRESETS if n not in names]
+
+    def _muts(selected):
+        if "all" in selected:
+            return list(MUTATIONS)
+        for n in selected:
+            if n not in MUTATIONS:
+                ap.error(f"unknown mutation {n!r} "
+                         f"(known: {sorted(MUTATIONS)})")
+        return list(selected)
+
+    results = []
+    ok = True
+    ran = 0
+    for name in names:
+        res, good = _run_one(preset(name), args)
+        results.append(res.to_dict())
+        ok = ok and good
+        ran += 1
+    for name in _muts(args.mutate):
+        res, good = _run_mutation(name, args)
+        results.append({"mutation": name, "ok": good,
+                        "armed": res.to_dict()})
+        ok = ok and good
+        ran += 1
+    for name in _muts(args.clean_twin):
+        res, good = _run_mutation(name, args, clean_twin=True)
+        results.append({"clean_twin": name, "ok": good,
+                        "clean": res.to_dict()})
+        ok = ok and good
+        ran += 1
+    if not ran:
+        print("fleetcheck: NOTHING selected — nothing was checked")
+        ok = False
+
+    payload = {"ok": ok, "results": results}
+    if args.json:
+        text = json.dumps(payload, indent=2)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w") as f:
+                f.write(text)
+    print("fleetcheck: "
+          + ("ALL CHECKS HOLD" if ok else "VIOLATION (or budget blown)")
+          + f" [{ran} scenario(s)]")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
