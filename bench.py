@@ -339,6 +339,80 @@ def run_z3_prefetch_ab():
     )
 
 
+def ckpt_ab_mode() -> bool:
+    """BENCH_CKPT_AB=1 → CPU-mesh A/B of the async checkpoint snapshot
+    pipeline (checkpoint.async_save — runtime/ckpt)."""
+    return _force_cpu_mesh_mode("BENCH_CKPT_AB")
+
+
+def run_ckpt_ab():
+    """Sync vs async ``save_checkpoint`` every K steps on the CPU mesh.
+    Prints ONE JSON line with the no-save baseline step time, both
+    saving legs' step times (the async fence should sit within noise of
+    the baseline while the sync leg eats the full serialize+write on
+    the step) and the analytic ckpt_snapshot MiB/step. Same CPU-mesh
+    validation protocol as run_moe_a2a_ab — no perf record is banked;
+    exactness of the async path is tests/test_ckpt.py's job."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.models import llama
+
+    B, S, K, N = 8, 128, 2, 6
+    model = llama(
+        "llama-tiny", vocab_size=512, max_seq_len=S, hidden_size=128,
+        num_layers=4, num_heads=8, num_kv_heads=4, head_dim=16,
+        intermediate_size=512,
+    )
+    data = {
+        "input_ids": np.random.RandomState(0).randint(0, 512, size=(B, S))
+    }
+
+    def leg(save, async_save):
+        comm.destroy_process_group()
+        zero = {"stage": 3, "stage3_param_persistence_threshold": 1000}
+        cfg = make_ds_config(B, zero, "none", 1, {})
+        cfg["checkpoint"] = {
+            "async_save": async_save,
+            "save_interval_steps": K if save else 0,
+            "keep_last": 2,
+            "on_preempt": "none",
+        }
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        save_dir = tempfile.mkdtemp(prefix="dstpu_ckpt_ab_")
+        engine.train_batch(batch=data)  # compile
+        t0 = time.perf_counter()
+        for i in range(N):
+            engine.train_batch(batch=data)
+            if save and (i + 1) % K == 0:
+                engine.save_checkpoint(save_dir)
+        jax.block_until_ready(engine.state.params)
+        dt = (time.perf_counter() - t0) / N
+        stream = engine.analytic_streams().get("ckpt_snapshot") or {}
+        engine.destroy()  # drains the background writer
+        shutil.rmtree(save_dir, ignore_errors=True)
+        return dt, stream
+
+    dt_base, _ = leg(False, False)
+    dt_sync, _ = leg(True, False)
+    dt_async, stream = leg(True, True)
+    return _ab_result(
+        "ckpt async-save A/B (CPU-mesh validation, not a perf record)",
+        dt_sync, dt_async, stream.get("bytes_per_step", 0),
+        extra={
+            "step_s_nosave": round(dt_base, 4),
+            "snapshot_mib": round(
+                stream.get("snapshot_bytes", 0) / 2**20, 3
+            ),
+            "save_interval_steps": K,
+        },
+    )
+
+
 # Campaign-callable A/B legs: each runs its own CPU-mesh serial-vs-variant
 # measurement and RETURNS the JSON-line dict it prints, so autoplan
 # --campaign (and tests) can invoke the exact CLI protocol
@@ -349,6 +423,7 @@ AB_LEGS = {
     "moe_a2a": run_moe_a2a_ab,
     "qgz_wires": run_qgz_ab,
     "z3_prefetch": run_z3_prefetch_ab,
+    "ckpt_async": run_ckpt_ab,
 }
 
 
@@ -771,6 +846,8 @@ def main():
         return run_z3_prefetch_ab()
     if qgz_ab_mode():
         return run_qgz_ab()
+    if ckpt_ab_mode():
+        return run_ckpt_ab()
     smoke = smoke_mode()
     enable_compile_cache()
     import deepspeed_tpu

@@ -915,11 +915,42 @@ class CheckpointConfig:
     checkpoint_engine (torch-native / nebula → native shard files / Orbax)."""
 
     engine: str = "native"  # native (shard .npy files) | orbax
+    # async snapshot pipeline (runtime/ckpt): overlap the shard write with
+    # the next step's math; the snapshot fence is the only synchronous cost
+    async_save: bool = False
+    # keep only the newest N committed tags (0 = keep everything)
+    keep_last: int = 0
+    # declared save cadence (every N global steps, 0 = no periodic saves):
+    # the train loop's contract, and the amortization window the
+    # ckpt_snapshot analytic stream prices against the roofline
+    save_interval_steps: int = 0
+    # SIGTERM (preemption) behavior once a save_dir is known:
+    # "save" chains a final sync save in front of healthwatch's postmortem
+    on_preempt: str = "save"  # save | none
 
     def validate(self) -> None:
         if self.engine not in ("native", "orbax"):
             raise DeepSpeedConfigError(
                 f"checkpoint.engine must be 'native' or 'orbax', got {self.engine!r}"
+            )
+        if self.keep_last < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.keep_last must be >= 0, got {self.keep_last}"
+            )
+        if self.save_interval_steps < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.save_interval_steps must be >= 0, got "
+                f"{self.save_interval_steps}"
+            )
+        if self.on_preempt not in ("save", "none"):
+            raise DeepSpeedConfigError(
+                f"checkpoint.on_preempt must be 'save' or 'none', got "
+                f"{self.on_preempt!r}"
+            )
+        if self.async_save and self.engine == "orbax":
+            raise DeepSpeedConfigError(
+                "checkpoint.async_save requires the native engine (orbax "
+                "keeps its own sync path)"
             )
 
 

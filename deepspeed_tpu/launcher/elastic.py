@@ -1,0 +1,183 @@
+"""Elastic supervisor: preemption-resilient multi-process training.
+
+Sits above the ``local`` launcher backend (one ``jax.distributed`` rank
+per subprocess, DSTPU_* env from :func:`.runner.build_launch_env`) and
+adds the recovery loop ROADMAP item 1 names:
+
+- **detect**: poll the worker processes; any death (SIGTERM'd by a
+  preemption, OOM-killed, nonzero exit) ends the round. The dying
+  worker's own process runs the runtime/ckpt SIGTERM chain first —
+  final sync save where possible, healthwatch postmortem always — the
+  supervisor only observes the exit.
+- **recompute**: tear down the surviving ranks (they would hang in
+  their next collective against the dead peer), shrink the world to the
+  survivors, and rebuild the launch env — a fresh coordinator port, a
+  fresh ``jax.distributed`` job, a smaller mesh.
+- **resume**: relaunch the same worker argv. Workers are resume-shaped
+  by contract: on start they load the latest *committed* tag (torn
+  saves are invisible — :mod:`...runtime.ckpt.manifest`) and reshard it
+  onto whatever mesh the new world size gives them
+  (:mod:`...runtime.ckpt.reshard`).
+
+``tools/elastic_run.py`` is the reference worker + the preemption
+oracle built on this class; the ci.yml ``preemption`` job drives it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import log_dist
+from .runner import build_launch_env, spawn_local
+
+#: exported to every worker: which recovery round it was launched in
+#: (0 = the initial launch). Lets a worker scope fault injection
+#: ("die in round 0 only") and log its lineage.
+ROUND_ENV = "DSTPU_ELASTIC_ROUND"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _rc(code: int) -> int:
+    """Popen returncode → 128+signal convention (launcher.runner's)."""
+    return 128 - code if code < 0 else code
+
+
+class ElasticSupervisor:
+    """Run ``worker_argv`` as an elastic multi-process job.
+
+    Each round spawns ``world`` local ranks on a fresh coordinator port.
+    A clean round (all ranks exit 0) ends the job with 0. A worker death
+    shrinks the world by the number of dead ranks and relaunches, until
+    ``min_workers`` can't be met or ``max_rounds`` recoveries happened —
+    then the last failure's exit code propagates."""
+
+    def __init__(
+        self,
+        worker_argv: List[str],
+        num_workers: int,
+        min_workers: int = 1,
+        max_rounds: int = 8,
+        coordinator: str = "127.0.0.1",
+        poll_s: float = 0.2,
+        grace_s: float = 10.0,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        if num_workers < 1 or min_workers < 1:
+            raise ValueError("num_workers and min_workers must be >= 1")
+        self.worker_argv = list(worker_argv)
+        self.num_workers = int(num_workers)
+        self.min_workers = int(min_workers)
+        self.max_rounds = int(max_rounds)
+        self.coordinator = coordinator
+        self.poll_s = float(poll_s)
+        self.grace_s = float(grace_s)
+        self.env = dict(env or {})
+        self.rounds: List[Dict] = []  # per-round {world, rc, dead} records
+
+    # ------------------------------------------------------------ round
+    def _spawn_round(self, world: int, rnd: int) -> List[subprocess.Popen]:
+        port = free_port()  # fresh jax.distributed job per round
+        procs = []
+        for pid in range(world):
+            env = build_launch_env(
+                self.coordinator, port, world, pid,
+                base_env={**os.environ, **self.env, ROUND_ENV: str(rnd)},
+            )
+            procs.append(spawn_local(env, self.worker_argv))
+        log_dist(
+            f"elastic: round {rnd}: launched {world} worker(s) "
+            f"(coordinator {self.coordinator}:{port})"
+        )
+        return procs
+
+    def _teardown(self, procs: List[subprocess.Popen]) -> None:
+        """terminate → grace → kill the still-running ranks. SIGTERM
+        first on purpose: it gives each survivor its own ckpt/postmortem
+        SIGTERM chain before the hard kill."""
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + self.grace_s
+        for p in procs:
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        # reap the late exits so the dead-count below is accurate
+        for p in procs:
+            if p.poll() is None:
+                p.wait()
+
+    def _babysit(self, procs: List[subprocess.Popen],
+                 forwarded: List[int]) -> int:
+        """Wait for the round to finish. Returns 0 on a clean round,
+        else the first failure's mapped exit code."""
+        while True:
+            if forwarded:
+                self._teardown(procs)
+                return 128 + forwarded[0]
+            codes = [p.poll() for p in procs]
+            if all(c == 0 for c in codes):
+                return 0
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed:
+                # let simultaneous deaths (a whole-host preemption) land
+                # before counting survivors
+                time.sleep(self.poll_s)
+                self._teardown(procs)
+                return _rc(failed[0])
+            time.sleep(self.poll_s)
+
+    # -------------------------------------------------------------- run
+    def run(self) -> int:
+        forwarded: List[int] = []
+
+        def _forward(signum, frame):
+            forwarded.append(signum)
+
+        old = (signal.signal(signal.SIGINT, _forward),
+               signal.signal(signal.SIGTERM, _forward))
+        world = self.num_workers
+        rc = 1
+        try:
+            for rnd in range(self.max_rounds + 1):
+                procs = self._spawn_round(world, rnd)
+                rc = self._babysit(procs, forwarded)
+                dead = sum(
+                    1 for p in procs if p.returncode not in (0, None)
+                )
+                self.rounds.append(
+                    {"round": rnd, "world": world, "rc": rc, "dead": dead}
+                )
+                if rc == 0:
+                    log_dist(f"elastic: round {rnd} completed cleanly")
+                    return 0
+                if forwarded:
+                    log_dist("elastic: supervisor signalled; giving up")
+                    return rc
+                # shrink to the survivors, but never below the capacity
+                # floor: a whole-job preemption (every rank SIGTERM'd)
+                # restarts at min_workers rather than giving up — the
+                # committed tags make the restart cheap either way
+                survivors = max(world - max(dead, 1), self.min_workers)
+                log_dist(
+                    f"elastic: round {rnd} lost {max(dead, 1)} worker(s) "
+                    f"(rc={rc}); resuming with world={survivors}"
+                )
+                world = survivors
+            return rc
+        finally:
+            signal.signal(signal.SIGINT, old[0])
+            signal.signal(signal.SIGTERM, old[1])

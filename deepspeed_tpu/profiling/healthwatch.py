@@ -169,6 +169,14 @@ DEFAULT_RULES: Dict[str, Dict[str, Any]] = {
         "enabled": True, "severity": "critical", "action": "log",
         "window": 16,
     },
+    # a sync save / snapshot fence exceeding its R8-priced budget by
+    # ``factor`` fires: the save is stealing step time the async pipeline
+    # (or a faster host path) should hide. ``budget_s`` of None defers to
+    # the engine-armed estimate (set_ckpt_budget: snapshot bytes / host_bw)
+    "checkpoint_stall": {
+        "enabled": True, "severity": "warn", "action": "log",
+        "budget_s": None, "factor": 4.0,
+    },
 }
 
 
@@ -386,6 +394,13 @@ class HealthWatch:
         self._step_times: deque = deque(maxlen=64)
         self._prediction: Optional[Dict[str, Any]] = None
         self._comm_est_s = 0.0
+        # checkpoint accounting: the engine arms the fence budget from the
+        # ckpt_snapshot stream's static price; the background writer adds
+        # its wall seconds here OUT-OF-BAND (they overlap training, so
+        # they must never land in a goodput bucket)
+        self._ckpt_budget_s: Optional[float] = None
+        self.ckpt_write_s = 0.0
+        self._ckpt_write_lock = threading.Lock()
         self._prev_fired: set = set()
         # zero_progress watchdog: token counter at the last serve tick
         # and the current length of the no-progress streak
@@ -441,6 +456,11 @@ class HealthWatch:
                     continue
                 if stream.get("overlapped"):
                     continue
+                if stream.get("goodput_bucket") == "checkpoint":
+                    # sync-save seconds are already charged to the
+                    # `checkpoint` bucket by the train/checkpoint span —
+                    # carving them from compute would double-count
+                    continue
                 total += stream_span_args(stream, hardware=hardware)[
                     "predicted_s_per_step"
                 ]
@@ -448,6 +468,22 @@ class HealthWatch:
         except Exception as e:  # noqa: BLE001
             log_dist(f"healthwatch: comm estimate skipped: {e}")
             self._comm_est_s = 0.0
+
+    def set_ckpt_budget(self, budget_s: float) -> None:
+        """Arm the ``checkpoint_stall`` watchdog with the statically
+        priced snapshot-fence seconds (ckpt_snapshot stream bytes /
+        host_bw). An operator-supplied ``budget_s`` in the rule config
+        wins over this estimate."""
+        if budget_s and budget_s > 0:
+            self._ckpt_budget_s = float(budget_s)
+
+    def add_ckpt_write_s(self, seconds: float) -> None:
+        """Background writer seconds — reported via goodput() /
+        ``health/ckpt_write_s`` but charged to NO bucket (the write
+        overlapped training; only the fence is goodput-visible).
+        Called from the writer thread, hence the lock."""
+        with self._ckpt_write_lock:
+            self.ckpt_write_s += float(seconds)
 
     # ---------------------------------------------------------- goodput
     def _drain_spans(self) -> List[Dict[str, Any]]:
@@ -511,6 +547,9 @@ class HealthWatch:
             "elapsed_s": round(el, 6),
             "buckets": buckets,
             "goodput_fraction": round(self.goodput_fraction(), 6),
+            # out-of-band: async-save write seconds overlapped training,
+            # so they appear beside the buckets, never inside them
+            "ckpt_write_s": round(self.ckpt_write_s, 6),
         }
 
     # ------------------------------------------------------- step hooks
@@ -629,6 +668,25 @@ class HealthWatch:
                 self._eval(evals, "grad_explosion", round(ratio, 3),
                            float(r["factor"]), False)
             self._gnorm_ewma.update(gnormf)
+        r = self._rule("checkpoint_stall")
+        if r:
+            ckpt_s = sum(
+                max(s["t1"] - s["t0"], 0.0)
+                for s in spans
+                if s["name"] == "train/checkpoint"
+            )
+            budget = r.get("budget_s") or self._ckpt_budget_s
+            if ckpt_s > 0 and budget:
+                limit = float(budget) * float(r.get("factor", 4.0))
+                if ckpt_s > limit:
+                    fire("checkpoint_stall", r, round(ckpt_s, 6),
+                         round(limit, 6),
+                         f"checkpoint fence {ckpt_s:.3f}s vs "
+                         f"{float(budget):.3f}s priced budget "
+                         f"(x{float(r.get('factor', 4.0)):g})")
+                else:
+                    self._eval(evals, "checkpoint_stall", round(ckpt_s, 6),
+                               round(limit, 6), False)
         self._eval_timing_rules(step_s, compiled, step, evals, fire)
         return self._finish_step(step, step_s, spans, evals, fired, {
             "loss": lossf,
@@ -812,6 +870,7 @@ class HealthWatch:
         extra = {"health/goodput": g["goodput_fraction"]}
         for k, v in g["buckets"].items():
             extra[f"health/goodput_{k}_s"] = v
+        extra["health/ckpt_write_s"] = g["ckpt_write_s"]
         for rule, n in self.counters.items():
             extra[f"health/{rule}"] = float(n)
         return extra

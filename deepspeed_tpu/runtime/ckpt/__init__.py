@@ -1,0 +1,49 @@
+"""Fault-tolerance control plane: async sharded checkpointing with
+resharding-on-restore (ROADMAP item 1's dynamic half; PAPER.md L5).
+
+Three pillars, one package:
+
+- :mod:`.async_writer` — the device→pinned-host snapshot pipeline and the
+  background shard writer behind ``save_checkpoint(..., async_save=True)``,
+  fenced by a :class:`~.async_writer.CheckpointGuard`.
+- :mod:`.reshard` — restore onto a *different* ``ParallelDims`` /
+  ``MeshTopology`` / ZeRO stage, assembling each destination shard from
+  only the overlapping source byte ranges.
+- :mod:`.manifest` — the committed-manifest-last atomicity rule: a tag is
+  visible to restore iff its manifest landed, so a torn save (killed
+  writer) can never be resumed from.
+
+The elastic supervisor (``launcher/elastic.py`` + ``tools/elastic_run.py``)
+rides these to survive preemption: SIGTERM → final sync save (chained in
+front of healthwatch's postmortem hook) → relaunch on the survivor mesh →
+resume from the latest *committed* tag. docs/checkpointing.md holds the
+manifest schema and the contracts.
+"""
+
+from .async_writer import (
+    CheckpointGuard,
+    install_preempt_handler,
+    reset_preempt_handler,
+    save_checkpoint,
+)
+from .manifest import (
+    MANIFEST_VERSION,
+    UncommittedCheckpointError,
+    is_committed,
+    latest_committed_tag,
+    require_committed,
+)
+from .reshard import load_checkpoint
+
+__all__ = [
+    "CheckpointGuard",
+    "MANIFEST_VERSION",
+    "UncommittedCheckpointError",
+    "install_preempt_handler",
+    "is_committed",
+    "latest_committed_tag",
+    "load_checkpoint",
+    "require_committed",
+    "reset_preempt_handler",
+    "save_checkpoint",
+]

@@ -1,0 +1,410 @@
+"""Async snapshot pipeline: device→pinned-host snapshot + background
+shard writer.
+
+``save_checkpoint(engine, ..., async_save=True)`` forks the save into
+two halves:
+
+- the **snapshot fence** (main thread, charged to the ``checkpoint``
+  goodput bucket): join any previous writer, copy this process's
+  replica-0 addressable shards device→host into
+  :class:`~..swap_tensor.PinnedBufferPool` buffers, rotate the pool's
+  generations. Step N+1's math can start the moment the fence returns —
+  double-buffered, the two-generation discipline guarantees the writer
+  of save N never reads a buffer save N+1 is refilling. Pool reuse is
+  safe even on CPU backends here (unlike the swapper's zero-copy
+  ``swap_in`` path) because the snapshot always COPIES into the buffer;
+  jax never holds a reference to it.
+- the **background write** (one writer thread, pure numpy + file I/O,
+  no jax): serialize each shard, then land the manifest LAST
+  (:mod:`.manifest` atomicity rule), advance ``latest``, prune
+  ``keep_last``. Its wall seconds are reported out-of-band
+  (``ckpt_write_s``), never to the goodput buckets — they overlap
+  training.
+
+A :class:`CheckpointGuard` fences the next save behind the in-flight
+writer and re-raises any writer exception **on the main thread** — a
+failed background save must fail the run loudly, not silently drop a
+restore point.
+
+Multi-process jobs fall back to a sync save: the commit requires a
+cross-process barrier (every process's shards before the one manifest)
+that a background thread cannot own safely. The sync path is this same
+writer run inline, so the on-disk result is identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist
+from ..checkpointing import (
+    _SHARD_FMT,
+    _ZERO_TO_FP32_SCRIPT,
+    _barrier,
+    _bounds_token,
+    _clean_component_dir,
+    _device_view,
+    _is_writer,
+    _leaf_paths,
+    _save_tree_orbax,
+)
+from ..swap_tensor import PinnedBufferPool
+from . import manifest as _manifest
+
+_COMPONENTS = ("params", "opt_state", "loss_scale")
+
+
+def _leaf_dimspec(leaf, ndim: int) -> Tuple[int, ...]:
+    """Per-dimension shard divisors of the sharding that is saving this
+    leaf (analysis/cost dimspec — the same vocabulary reshard's overlap
+    math speaks)."""
+    from ...analysis.cost.walk import dimspec_from_sharding
+
+    s = getattr(leaf, "sharding", None)
+    if s is None:
+        return (1,) * ndim
+    return dimspec_from_sharding(s, ndim, {})
+
+
+def _snapshot_tree(tree, pool: PinnedBufferPool):
+    """Device→host snapshot of this process's replica-0 shards.
+
+    Returns ``(entries, comp_meta)``: ``entries`` is
+    ``[(filename, host_buffer)]`` ready for the writer; ``comp_meta`` is
+    the component's manifest record (leaf names, global shapes, dtypes,
+    dimspecs, bounds tokens per shard)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    names = _leaf_paths(tree)
+    # start every D2H copy before draining any (pipelined transfers)
+    for leaf in leaves:
+        if hasattr(leaf, "copy_to_host_async"):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — pinned_host/odd transports
+                pass
+    entries: List[Tuple[str, np.ndarray]] = []
+    shapes, dtypes, dimspecs = [], [], []
+    shard_tokens: Dict[str, List[str]] = {}
+
+    def grab(i: int, token: str, data: np.ndarray) -> None:
+        buf = pool.take(data.shape, data.dtype)
+        buf[...] = data
+        entries.append((_SHARD_FMT.format(i, token), buf))
+        shard_tokens.setdefault(str(i), []).append(token)
+
+    for i, leaf in enumerate(leaves):
+        if not hasattr(leaf, "addressable_shards"):
+            arr = np.asarray(leaf)
+            shapes.append(list(arr.shape))
+            dtypes.append(str(arr.dtype))
+            dimspecs.append([1] * arr.ndim)
+            if _is_writer():  # host scalars/np arrays: tiny, process 0 only
+                token = _bounds_token(
+                    tuple(slice(0, d) for d in arr.shape), arr.shape
+                )
+                grab(i, token, arr)
+            continue
+        leaf = _device_view(leaf)
+        shapes.append(list(leaf.shape))
+        dtypes.append(str(np.dtype(leaf.dtype)))
+        dimspecs.append(list(_leaf_dimspec(leaf, leaf.ndim)))
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exactly one global writer per distinct shard
+            grab(i, _bounds_token(shard.index, leaf.shape),
+                 np.asarray(shard.data))
+    comp_meta = {
+        "num_leaves": len(leaves),
+        "leaf_names": names,
+        "leaf_shapes": shapes,
+        "leaf_dtypes": dtypes,
+        "leaf_dimspecs": dimspecs,
+        "shards": shard_tokens,
+    }
+    return entries, comp_meta
+
+
+class CheckpointGuard:
+    """Fences async saves: at most ONE writer in flight, writer
+    exceptions surface on the main thread at the next fence, and the
+    pinned pool's generations rotate only after the previous writer has
+    fully landed (so its buffers are provably quiescent)."""
+
+    def __init__(self, buffer_count: int = 4,
+                 on_write_done: Optional[Callable[[float], None]] = None):
+        self._pool = PinnedBufferPool(buffer_count=buffer_count)
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.last_write_s: Optional[float] = None
+        self.writes = 0  # completed background writes (tests/observability)
+        self.on_write_done = on_write_done
+
+    @property
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def fence(self) -> None:
+        """Block until the in-flight writer (if any) committed; re-raise
+        its failure HERE — the main thread must see a lost restore
+        point."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise RuntimeError(
+                f"async checkpoint writer failed: {exc!r} (the previous "
+                f"save did NOT commit; restore will resolve the last "
+                f"committed tag)"
+            ) from exc
+
+    def drain(self) -> None:
+        """fence() that only logs a writer failure (engine.destroy —
+        teardown must not raise)."""
+        try:
+            self.fence()
+        except RuntimeError as e:
+            log_dist(f"ckpt: {e}")
+
+    def rotate(self, bufs: List[np.ndarray]) -> None:
+        """Two-generation discipline: the generation before last becomes
+        reusable now that this one is snapshotted. fence() ran first, so
+        no write is pending against the retiring buffers."""
+        self._pool.retire_generation(list(bufs), pending_ids=frozenset())
+
+    def launch(self, fn: Callable[[], None]) -> None:
+        if self.in_flight:  # save_checkpoint fences first; belt+braces
+            raise RuntimeError("CheckpointGuard: a writer is already in flight")
+        def body():
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced at fence
+                self._exc = e
+            finally:
+                dt = time.perf_counter() - t0
+                self.last_write_s = dt
+                self.writes += 1
+                cb = self.on_write_done
+                if cb is not None:
+                    try:
+                        cb(dt)
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
+        # non-daemon: a normal interpreter exit waits for the commit
+        # instead of tearing a save mid-write
+        self._thread = threading.Thread(target=body, name="ckpt-writer")
+        self._thread.start()
+
+
+def _build_meta(engine, tag: str, client_state: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    state = engine.state
+    return {
+        "manifest_version": _manifest.MANIFEST_VERSION,
+        "tag": tag,
+        "global_steps": engine.global_steps,
+        "micro_steps": engine.micro_steps,
+        "skipped_steps": engine.skipped_steps,
+        "step": int(jax.device_get(state.step)),
+        "rng": np.asarray(jax.device_get(engine._rng)).tolist(),
+        "client_state": client_state or {},
+        "zero_stage": engine.config.zero_config.stage,
+        "world_size": engine.topology.world_size,
+        "components": {},
+    }
+
+
+def _write_entries(path: str, entries_by_comp) -> None:
+    for comp, entries in entries_by_comp.items():
+        cdir = os.path.join(path, comp)
+        for fname, buf in entries:
+            np.save(os.path.join(cdir, fname), buf)
+
+
+def _commit(save_dir: str, tag: str, meta: Dict[str, Any],
+            keep_last: int) -> None:
+    """The manifest-last commit + root pointers (writer process only)."""
+    _manifest.write_manifest(save_dir, tag, meta)
+    _manifest.advance_latest(save_dir, tag)
+    with open(os.path.join(save_dir, "zero_to_fp32.py"), "w") as f:
+        f.write(_ZERO_TO_FP32_SCRIPT)
+    _manifest.prune_keep_last(save_dir, keep_last)
+
+
+def save_checkpoint(
+    engine,
+    save_dir: str,
+    tag: Optional[str] = None,
+    client_state: Optional[Dict[str, Any]] = None,
+    async_save: bool = False,
+    guard: Optional[CheckpointGuard] = None,
+) -> str:
+    """Native-engine save through the snapshot pipeline (sync = the same
+    writer run inline). Returns the tag directory like the legacy API.
+
+    The caller's ``train/checkpoint`` span should cover only this call's
+    synchronous portion: for an async save that IS the snapshot fence —
+    the write happens behind the returned control flow."""
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    ckpt_cfg = getattr(engine.config, "checkpoint", None)
+    keep_last = int(getattr(ckpt_cfg, "keep_last", 0) or 0)
+    if getattr(ckpt_cfg, "engine", "native") == "orbax":
+        # Orbax engine keeps the legacy (sync, own-format) path
+        from ..checkpointing import save_checkpoint as _legacy_save
+
+        return _legacy_save(engine, save_dir, tag=tag,
+                            client_state=client_state)
+    if async_save and jax.process_count() > 1:
+        log_dist(
+            "ckpt: async_save on a multi-process job falls back to sync "
+            "(the commit needs a cross-process barrier the background "
+            "writer cannot own)"
+        )
+        async_save = False
+    if guard is None:
+        guard = CheckpointGuard()
+
+    # ---- snapshot fence (main thread) -------------------------------
+    guard.fence()  # one in flight; surfaces the previous writer's failure
+    path = os.path.join(save_dir, str(tag))
+    if _is_writer():
+        os.makedirs(path, exist_ok=True)
+        # re-saving over a committed tag: demote it FIRST so a torn
+        # rewrite is uncommitted, not silently stale
+        mpath = _manifest.manifest_path(save_dir, tag)
+        if os.path.exists(mpath):
+            os.remove(mpath)
+    meta = _build_meta(engine, tag, client_state or {})
+    state = engine.state
+    trees = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "loss_scale": state.loss_scale,
+    }
+    entries_by_comp: Dict[str, list] = {}
+    bufs: List[np.ndarray] = []
+    for name, tree in trees.items():
+        cdir = os.path.join(path, name)
+        os.makedirs(cdir, exist_ok=True)
+        _clean_component_dir(cdir)  # stale generation/format out first
+        entries, comp_meta = _snapshot_tree(tree, guard._pool)
+        meta["components"][name] = comp_meta
+        entries_by_comp[name] = entries
+        bufs.extend(b for _, b in entries)
+    guard.rotate(bufs)
+
+    # ---- write + commit ---------------------------------------------
+    if async_save:
+        def write():
+            _write_entries(path, entries_by_comp)
+            _commit(save_dir, tag, meta, keep_last)
+            log_dist(f"saved checkpoint {path} (async)")
+        guard.launch(write)
+        return path
+
+    t0 = time.perf_counter()
+    _write_entries(path, entries_by_comp)
+    _barrier("ckpt_shards")  # every process's shards before the ONE commit
+    if _is_writer():
+        _commit(save_dir, tag, meta, keep_last)
+    _barrier("ckpt_commit")  # non-writers must not race ahead of the commit
+    guard.last_write_s = time.perf_counter() - t0
+    guard.writes += 1
+    if guard.on_write_done is not None:
+        try:
+            guard.on_write_done(guard.last_write_s)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+    log_dist(f"saved checkpoint {path}")
+    return path
+
+
+# ----------------------------------------------- preemption (SIGTERM)
+_PREEMPT_LOCK = threading.Lock()
+_PREEMPT = {"installed": False, "prev": None, "engines": []}
+
+
+def install_preempt_handler(engine, save_dir: str) -> None:
+    """Chain a SIGTERM handler that runs a FINAL SYNC SAVE before
+    handing off to whatever was installed before (healthwatch's chain,
+    when armed, dumps its postmortem next — evidence AND a restore
+    point). Installed in front, so the save happens while the process
+    is still healthy. Engines register once per (engine, save_dir).
+
+    Single-process only: a preempted rank cannot complete the
+    cross-process barriers a collective save needs while its peers keep
+    training — multi-process jobs resume from the last interval save
+    (which is the committed-tag contract anyway)."""
+    import signal
+
+    with _PREEMPT_LOCK:
+        pairs = _PREEMPT["engines"]
+        if not any(e is engine for e, _ in pairs):
+            pairs.append((engine, save_dir))
+        else:
+            _PREEMPT["engines"] = [
+                (e, save_dir if e is engine else d) for e, d in pairs
+            ]
+        if _PREEMPT["installed"]:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return  # signal handlers only install from the main thread
+        def _on_sigterm(signum, frame):
+            for eng, sdir in list(_PREEMPT["engines"]):
+                try:
+                    if jax.process_count() > 1:
+                        log_dist(
+                            "ckpt: preempted on a multi-process job — "
+                            "skipping the final save (peers would hang "
+                            "in its barriers); resume uses the last "
+                            "committed interval tag"
+                        )
+                        continue
+                    if getattr(eng, "state", None) is None:
+                        continue  # destroyed engine
+                    eng.save_checkpoint(sdir, async_save=False)
+                    log_dist("ckpt: preemption save committed")
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log_dist(f"ckpt: preemption save failed: {e}")
+            prev = _PREEMPT["prev"]
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_IGN:
+                return
+            else:
+                raise SystemExit(128 + int(signum))
+        try:
+            _PREEMPT["prev"] = signal.signal(signal.SIGTERM, _on_sigterm)
+            _PREEMPT["installed"] = True
+        except (ValueError, OSError):
+            _PREEMPT["prev"] = None
+
+
+def reset_preempt_handler() -> None:
+    """Tests: restore the chained SIGTERM handler and drop registrations."""
+    import signal
+
+    with _PREEMPT_LOCK:
+        if _PREEMPT["installed"]:
+            try:
+                if threading.current_thread() is threading.main_thread():
+                    signal.signal(
+                        signal.SIGTERM,
+                        _PREEMPT["prev"] or signal.SIG_DFL,
+                    )
+            except (ValueError, OSError):
+                pass
+        _PREEMPT["installed"] = False
+        _PREEMPT["prev"] = None
+        _PREEMPT["engines"] = []

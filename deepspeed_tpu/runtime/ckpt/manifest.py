@@ -1,0 +1,140 @@
+"""Checkpoint manifest: the commit record and the atomicity rule.
+
+A tag directory is COMMITTED iff its ``metadata.json`` exists — shard
+files land first, the manifest lands last (via write-to-temp +
+``os.replace``, so it is never observable half-written), and the root
+``latest`` pointer is only advanced after the commit. A writer killed
+mid-save therefore leaves a torn tag that is *invisible* to restore:
+``latest`` still names the previous committed tag, ``list_checkpoints``
+skips the torn directory, and explicitly requesting the torn tag raises
+:class:`UncommittedCheckpointError` loudly instead of assembling a
+corrupt tree.
+
+The manifest is a superset of the legacy ``metadata.json`` (so every
+pre-manifest checkpoint remains readable): per component it additionally
+records every leaf's **global shape**, dtype, per-dimension shard
+divisors (the ``analysis/cost`` dimspec of the sharding that saved it)
+and the ``bounds_token`` layout per shard — everything
+:mod:`.reshard` needs to assemble a *different* mesh's shards from only
+the overlapping source byte ranges. Schema in docs/checkpointing.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from ...utils.logging import log_dist
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "metadata.json"
+
+
+class UncommittedCheckpointError(RuntimeError):
+    """An explicitly requested tag exists on disk but never committed
+    (torn save: the writer died before its manifest landed)."""
+
+
+def manifest_path(save_dir: str, tag: str) -> str:
+    return os.path.join(save_dir, str(tag), MANIFEST_NAME)
+
+
+def is_committed(save_dir: str, tag: str) -> bool:
+    return os.path.exists(manifest_path(save_dir, tag))
+
+
+def require_committed(save_dir: str, tag: str) -> str:
+    """The refuse-torn-saves gate: the tag's directory path, or a loud
+    error naming the torn tag when shards exist without a manifest."""
+    path = os.path.join(save_dir, str(tag))
+    if is_committed(save_dir, tag):
+        return path
+    if os.path.isdir(path):
+        raise UncommittedCheckpointError(
+            f"checkpoint tag {tag!r} under {save_dir!r} is NOT committed "
+            f"(shard files without a manifest — the writer died mid-save). "
+            f"Refusing to restore a torn checkpoint; resume from the "
+            f"latest committed tag instead (tag=None)."
+        )
+    raise FileNotFoundError(
+        f"no checkpoint tag {tag!r} under {save_dir!r}"
+    )
+
+
+def latest_committed_tag(save_dir: str) -> Optional[str]:
+    """Resolve the newest committed tag. ``latest`` is written only
+    after a commit so it normally IS committed; if a crash left it
+    pointing at a torn tag anyway (or at a deleted one), fall back to
+    the newest committed directory rather than failing the resume."""
+    latest = os.path.join(save_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            tag = f.read().strip()
+        if tag and is_committed(save_dir, tag):
+            return tag
+        log_dist(
+            f"ckpt: `latest` names uncommitted tag {tag!r} (torn save?); "
+            f"falling back to the newest committed tag"
+        )
+    from ..checkpointing import list_checkpoints
+
+    tags = list_checkpoints(save_dir)  # committed-only by construction
+    return tags[-1] if tags else None
+
+
+def read_manifest(save_dir: str, tag: str) -> Dict[str, Any]:
+    with open(manifest_path(save_dir, tag)) as f:
+        return json.load(f)
+
+
+def write_manifest(save_dir: str, tag: str, meta: Dict[str, Any]) -> str:
+    """Atomically land the manifest — the commit point of a save. Must
+    be called only after every shard file of the tag is on disk."""
+    path = manifest_path(save_dir, tag)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def advance_latest(save_dir: str, tag: str) -> None:
+    """Point ``latest`` at a freshly committed tag (atomic for the same
+    reason as the manifest: a reader must never see a half-written
+    pointer)."""
+    path = os.path.join(save_dir, "latest")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(tag))
+    os.replace(tmp, path)
+
+
+def prune_keep_last(save_dir: str, keep_last: int) -> list:
+    """Delete committed tags beyond the newest ``keep_last`` (0 keeps
+    everything). Torn tags are also swept — they are unreachable by
+    construction and only waste disk. Returns the removed tag names."""
+    if keep_last <= 0:
+        return []
+    import shutil
+
+    from ..checkpointing import list_checkpoints
+
+    committed = list_checkpoints(save_dir)
+    doomed = committed[:-keep_last] if len(committed) > keep_last else []
+    doomed += [
+        d
+        for d in os.listdir(save_dir)
+        if os.path.isdir(os.path.join(save_dir, d))
+        and d not in committed
+        and not is_committed(save_dir, d)
+        # only sweep dirs that are recognizably torn TAGS (have a params
+        # component) — never a foreign directory a user parked here
+        and os.path.isdir(os.path.join(save_dir, d, "params"))
+    ]
+    for tag in doomed:
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+        log_dist(f"ckpt: pruned tag {tag} (keep_last={keep_last})")
+    return doomed

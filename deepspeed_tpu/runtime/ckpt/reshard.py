@@ -1,0 +1,260 @@
+"""Resharding-on-restore: load a checkpoint onto a *different* mesh.
+
+The manifest records every leaf's global shape and the ``bounds_token``
+layout of each saved shard (a rectangle of the one logical array). A
+restore onto a different ``ParallelDims`` / ``MeshTopology`` / ZeRO
+stage therefore never needs the source mesh: for each **destination**
+shard, jax hands us its global index rectangle and we assemble it by
+reading only the overlapping source byte ranges (``np.load(...,
+mmap_mode="r")`` + per-dimension interval intersection), then re-put the
+finished array to the engine's real target sharding. ZeRO-partitioned
+optimizer state reshards the same way — its leaves are sharding
+annotations on one logical array, not rank-local fragments.
+
+The overlap math speaks the :mod:`...analysis.cost` dimspec vocabulary
+(per-dimension shard divisors via :func:`dimspec_from_sharding`), the
+same machinery R2/R8 use to price shardings statically — the restore's
+per-device read volume is exactly ``device_bytes(shape, dtype,
+dimspec)``.
+
+The explicit ``device_put`` to the destination sharding at the end is
+the load-bearing step (the shardlint R2 ``restore_drops_sharding``
+hazard is this path with that line missing): rebuilding a donated
+carry's tree from host arrays without re-putting to its resting
+shardings silently de-shards the next step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist
+from ..checkpointing import (
+    _ORBAX_SUBDIR,
+    _assemble_leaf,
+    _barrier,
+    _index_shard_files,
+    _load_tree_orbax,
+)
+from . import manifest as _manifest
+
+
+def _stored_shape(entries) -> Optional[Tuple[int, ...]]:
+    """Global shape of a stored leaf from its shard inventory (max stop
+    per dimension; legacy full-array files report their own shape)."""
+    bounds0, path0 = entries[0]
+    if bounds0 is None:  # legacy unsharded file
+        return tuple(np.load(path0, mmap_mode="r").shape)
+    if bounds0 == ():  # 0-d
+        return ()
+    ndim = len(bounds0)
+    shape = [0] * ndim
+    for bounds, _ in entries:
+        if bounds is None or len(bounds) != ndim:
+            return None  # mixed layouts — let _assemble_leaf raise loudly
+        for d, sl in enumerate(bounds):
+            shape[d] = max(shape[d], sl.stop)
+    return tuple(shape)
+
+
+def _read_overlap(entries, dst_bounds, shape, dtype) -> np.ndarray:
+    """Assemble ONE destination rectangle from the overlapping source
+    rectangles, reading only the intersecting ranges of each shard file
+    (mmap: untouched source bytes never leave the page cache)."""
+    full = tuple(slice(0, d) for d in shape)
+    dst_shape = tuple(sl.stop - sl.start for sl in dst_bounds)
+    out = np.empty(dst_shape, dtype)
+    covered = 0
+    for bounds, path in entries:
+        src_bounds = full if bounds in (None, ()) else bounds
+        inter = []
+        for sb, db in zip(src_bounds, dst_bounds):
+            lo, hi = max(sb.start, db.start), min(sb.stop, db.stop)
+            if lo >= hi:
+                inter = None
+                break
+            inter.append((lo, hi))
+        if inter is None:
+            continue
+        src = np.load(path, mmap_mode="r")
+        src_sel = tuple(
+            slice(lo - sb.start, hi - sb.start)
+            for (lo, hi), sb in zip(inter, src_bounds)
+        )
+        dst_sel = tuple(
+            slice(lo - db.start, hi - db.start)
+            for (lo, hi), db in zip(inter, dst_bounds)
+        )
+        out[dst_sel] = src[src_sel]
+        covered += int(np.prod([hi - lo for lo, hi in inter]))
+    if covered != out.size:  # saved rectangles tile the array disjointly
+        raise ValueError(
+            f"corrupt checkpoint: destination shard {dst_bounds} of shape "
+            f"{shape} only covered by {covered}/{out.size} stored elements "
+            f"under {os.path.dirname(entries[0][1])} (missing shard files?)"
+        )
+    return out
+
+
+def _resharded_leaf(entries, shape, dtype, sharding):
+    """Build one destination-sharded jax.Array: per destination shard,
+    read only the overlapping source ranges, then ONE explicit re-put to
+    the engine's real target sharding (memory kind included)."""
+    from jax.sharding import NamedSharding
+
+    # assemble in default device memory; the re-put below moves it to the
+    # target's memory kind (pinned_host offload targets can't always be
+    # written through make_array_from_callback directly)
+    assemble = NamedSharding(sharding.mesh, sharding.spec)
+    cache: Dict[Tuple, np.ndarray] = {}
+
+    def cb(index):
+        bounds = tuple(
+            slice(
+                0 if sl.start is None else int(sl.start),
+                dim if sl.stop is None else int(sl.stop),
+            )
+            for sl, dim in zip(index, shape)
+        )
+        key = tuple((b.start, b.stop) for b in bounds)
+        if key not in cache:  # replicated axes ask for the same rectangle
+            cache[key] = _read_overlap(entries, bounds, shape, dtype)
+        return cache[key]
+
+    arr = jax.make_array_from_callback(tuple(shape), assemble, cb)
+    return jax.device_put(arr, sharding)  # the R2-clean re-put
+
+
+def _load_tree_resharded(template, directory: str, shardings=None,
+                         strict: bool = True, stored_names=None):
+    """`_load_tree` with per-destination-shard overlap reads instead of
+    whole-leaf assembly. Leaf matching (recorded pytree path with
+    flat-index fallback) and strict=False semantics are identical."""
+    from jax.sharding import NamedSharding
+
+    from ...analysis.cost.walk import device_bytes, dimspec_from_sharding
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(template)
+    names = [jax.tree_util.keystr(path) for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    shard_leaves = (
+        jax.tree_util.tree_leaves(shardings)
+        if shardings is not None
+        else [None] * len(leaves)
+    )
+    files = _index_shard_files(directory)
+    if stored_names and len(stored_names) == len(set(stored_names)):
+        name_to_stored = {n: i for i, n in enumerate(stored_names)}
+    else:
+        name_to_stored = {n: i for i, n in enumerate(names)}  # positional
+
+    loaded = []
+    read_bytes = 0  # per-device restore read volume (dimspec-priced)
+    for i, (name, old) in enumerate(zip(names, leaves)):
+        stored_i = name_to_stored.get(name)
+        entries = files.get(stored_i) if stored_i is not None else None
+        s = shard_leaves[i] if i < len(shard_leaves) else None
+        if not entries:
+            if strict:
+                raise FileNotFoundError(
+                    f"checkpoint missing leaf {name!r} (index {stored_i}) "
+                    f"under {directory}"
+                )
+            log_dist(f"strict=False: missing leaf {name}, keeping current value")
+            loaded.append(old)
+            continue
+        shape = _stored_shape(entries)
+        if shape is not None and tuple(old.shape) != shape:
+            if strict:
+                raise ValueError(
+                    f"checkpoint leaf {name} shape {shape} != expected "
+                    f"{tuple(old.shape)} (did the model/optimizer config "
+                    f"change? pass strict=False to keep mismatched leaves at "
+                    f"their current values)"
+                )
+            log_dist(
+                f"strict=False: leaf {name} shape {shape} != "
+                f"{tuple(old.shape)}, keeping current value"
+            )
+            loaded.append(old)
+            continue
+        dtype = np.dtype(old.dtype)
+        if isinstance(s, NamedSharding) and shape:
+            dimspec = dimspec_from_sharding(s, len(shape), {})
+            read_bytes += device_bytes(shape, dtype, dimspec)
+            loaded.append(_resharded_leaf(entries, shape, dtype, s))
+        else:
+            # scalars / non-mesh shardings: whole-leaf assembly is already
+            # minimal, but the re-put discipline is the same
+            arr = np.asarray(_assemble_leaf(entries), dtype=dtype)
+            read_bytes += arr.nbytes
+            loaded.append(jax.device_put(arr, s) if s is not None else arr)
+    if shardings is not None:
+        log_dist(
+            f"reshard: {directory.rsplit(os.sep, 1)[-1]}: {len(leaves)} "
+            f"leaves, {read_bytes / 2**20:.1f} MiB/device overlap reads"
+        )
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, loaded)
+
+
+def load_checkpoint(
+    engine,
+    load_dir: str,
+    tag: Optional[str] = None,
+    strict: bool = True,
+) -> Tuple[Optional[str], Dict[str, Any]]:
+    """Restore engine state onto the engine's OWN mesh, whatever mesh
+    saved it. Torn (uncommitted) tags are refused loudly when named and
+    invisible when resolving ``latest``. Returns (path, client_state)."""
+    _barrier("load_checkpoint")  # don't read while a peer is mid-save
+    if tag is None:
+        tag = _manifest.latest_committed_tag(load_dir)
+        if tag is None:
+            log_dist(f"no committed checkpoint under {load_dir}; nothing loaded")
+            return None, {}
+    path = _manifest.require_committed(load_dir, tag)
+    meta = _manifest.read_manifest(load_dir, tag)
+    state = engine.state
+
+    def stored_names(component):
+        return (meta.get("components", {}).get(component) or {}).get("leaf_names")
+
+    def load_component(template, component, shardings):
+        cdir = os.path.join(path, component)
+        # format auto-detected from disk, so either engine reads either layout
+        if os.path.isdir(os.path.join(cdir, _ORBAX_SUBDIR)):
+            return _load_tree_orbax(template, cdir, shardings, strict)
+        return _load_tree_resharded(
+            template, cdir, shardings, strict, stored_names(component)
+        )
+
+    params = load_component(state.params, "params", engine.param_shardings)
+    opt_state = load_component(state.opt_state, "opt_state", engine.opt_shardings)
+    loss_scale = load_component(
+        state.loss_scale,
+        "loss_scale",
+        jax.tree.map(lambda _: engine._replicated, state.loss_scale),
+    )
+
+    import jax.numpy as jnp
+
+    engine.state = type(state)(
+        params,
+        opt_state,
+        loss_scale,
+        jax.device_put(jnp.asarray(meta["step"], jnp.int32), engine._replicated),
+    )
+    engine.global_steps = meta["global_steps"]
+    engine.micro_steps = meta["micro_steps"]
+    engine.skipped_steps = meta["skipped_steps"]
+    engine._rng = jnp.asarray(np.asarray(meta["rng"], dtype=np.uint32))
+    log_dist(
+        f"loaded checkpoint {path} (step {meta['global_steps']}, resharded "
+        f"onto {engine.topology.world_size} devices)"
+    )
+    return path, meta.get("client_state", {})

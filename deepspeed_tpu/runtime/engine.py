@@ -641,6 +641,7 @@ class TpuEngine:
         off_opt = zc.offload_optimizer
         off_par = zc.offload_param
         self._nvme_swapper = None
+        self._checkpoint_guard = None  # lazy (runtime/ckpt CheckpointGuard)
         self._opt_memory_kind = None
         if off_opt.device == "cpu":
             # XLA's CPU SPMD partitioner can't annotate memory kinds, so the
@@ -1063,7 +1064,76 @@ class TpuEngine:
                 "per_device_bytes_per_step": pw["bytes_per_step"],
                 "overlapped": False,
             }
+        # periodic checkpoint snapshots (runtime/ckpt): device→host bytes
+        # amortized over the declared save cadence, so R8/shardplan price
+        # the async pipeline against the roofline window like any other
+        # offload stream. goodput_bucket marks its synchronous cost as
+        # already charged to the `checkpoint` bucket — healthwatch must
+        # not carve it out of compute spans a second time.
+        ckpt_cfg = getattr(self.config, "checkpoint", None)
+        interval = int(getattr(ckpt_cfg, "save_interval_steps", 0) or 0)
+        if interval > 0:
+            try:
+                snap_total, snap_dev = self._ckpt_snapshot_bytes()
+            except Exception:  # noqa: BLE001 — abstract/odd state trees
+                snap_total = snap_dev = 0.0
+            if snap_total > 0:
+                streams["ckpt_snapshot"] = {
+                    "kind": "offload",
+                    "bytes_per_step": snap_total / interval,
+                    "per_device_bytes_per_step": snap_dev / interval,
+                    "overlapped": bool(
+                        getattr(ckpt_cfg, "async_save", False)
+                    ),
+                    "goodput_bucket": "checkpoint",
+                    "interval_steps": interval,
+                    "snapshot_bytes": snap_total,
+                    "per_device_snapshot_bytes": snap_dev,
+                }
         return streams
+
+    def _ckpt_snapshot_bytes(self):
+        """(global, per-device) bytes of one checkpoint snapshot — the
+        params + optimizer-state + loss-scale trees the ckpt writer
+        serializes. Per-device uses each leaf's sharding dimspec (the
+        same analysis/cost pricing reshard's overlap reads report)."""
+        from ..analysis.cost.walk import device_bytes, dimspec_from_sharding
+
+        state = self.state
+        if state is None:
+            return 0.0, 0.0
+        world = max(self.topology.world_size, 1)
+        total = per_dev = 0.0
+        for tree, sh in (
+            (state.params, self.param_shardings),
+            (state.opt_state, self.opt_shardings),
+            (state.loss_scale, None),
+        ):
+            leaves = jax.tree_util.tree_leaves(tree)
+            shardings = (
+                jax.tree_util.tree_leaves(sh)
+                if sh is not None
+                else [None] * len(leaves)
+            )
+            for i, leaf in enumerate(leaves):
+                shape = tuple(getattr(leaf, "shape", ()) or ())
+                dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+                n = float(dtype.itemsize)
+                for d in shape:
+                    n *= int(d)
+                total += n
+                s = shardings[i] if i < len(shardings) else None
+                if s is not None and shape:
+                    try:
+                        per_dev += device_bytes(
+                            shape, dtype,
+                            dimspec_from_sharding(s, len(shape), {}),
+                        )
+                    except Exception:  # noqa: BLE001 — duck-typed shardings
+                        per_dev += n / world
+                else:
+                    per_dev += n
+        return total, per_dev
 
     def parity_pairs(self):
         """The declared-bitwise form pairs of this engine's train step
@@ -2602,9 +2672,22 @@ class TpuEngine:
             hw_cfg, self.tracer, source="train",
             context={"config": self.config.to_dict()},
         )
-        self.healthwatch.set_comm_estimate_from_streams(
-            self.analytic_streams()
-        )
+        streams = self.analytic_streams()
+        self.healthwatch.set_comm_estimate_from_streams(streams)
+        snap = streams.get("ckpt_snapshot")
+        if snap:
+            # arm the checkpoint_stall watchdog: fence budget = snapshot
+            # bytes over the host link (same static pricing as R8)
+            try:
+                from ..analysis.cost.hardware import HardwareModel
+
+                host_bw = float(HardwareModel.detect().host_bw)
+                if host_bw > 0:
+                    self.healthwatch.set_ckpt_budget(
+                        float(snap["per_device_snapshot_bytes"]) / host_bw
+                    )
+            except Exception as e:  # noqa: BLE001 — telemetry only
+                log_dist(f"healthwatch: ckpt budget skipped: {e}")
         return self.healthwatch
 
     def enable_healthwatch(self, **overrides):
@@ -2843,19 +2926,50 @@ class TpuEngine:
         yield
 
     # --------------------------------------------------------- checkpointing
-    def save_checkpoint(self, save_dir, tag=None, client_state=None):
-        self._check_concrete("save_checkpoint")
-        from .checkpointing import save_checkpoint as _save
+    def _ckpt_guard(self):
+        """Lazy per-engine CheckpointGuard: fences async saves and routes
+        background write seconds to healthwatch (out-of-band, never the
+        goodput buckets — the write overlaps training)."""
+        if self._checkpoint_guard is None:
+            from .ckpt import CheckpointGuard
 
-        # checkpoint time is its own goodput bucket (ISSUE 11): the
-        # span covers the swap-in, the gather/write and the swap-out
+            def on_write_done(seconds):
+                hw = self.healthwatch
+                if hw is not None:
+                    hw.add_ckpt_write_s(seconds)
+
+            self._checkpoint_guard = CheckpointGuard(
+                on_write_done=on_write_done
+            )
+        return self._checkpoint_guard
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        async_save=None):
+        self._check_concrete("save_checkpoint")
+        from .ckpt import save_checkpoint as _save
+        from .ckpt.async_writer import install_preempt_handler
+
+        ckpt_cfg = self.config.checkpoint
+        if async_save is None:
+            async_save = bool(getattr(ckpt_cfg, "async_save", False))
+        if getattr(ckpt_cfg, "on_preempt", "save") == "save":
+            # first save teaches SIGTERM where restore points live: a
+            # preemption now triggers a final sync save ahead of
+            # healthwatch's postmortem chain
+            install_preempt_handler(self, save_dir)
+        # checkpoint time is its own goodput bucket (ISSUE 11). The span
+        # covers only the SYNCHRONOUS cost: swap-in, the snapshot fence
+        # (device→pinned-host copy), and the swap-out. An async save's
+        # shard write lands in the background and is reported separately
+        # as ckpt_write_s — charging it here would bill overlap as stall.
         sp = (self.tracer.begin("train/checkpoint", "train")
               if self.tracer is not None else None)
         if self._nvme_swapper is not None:
             self._swap_in_opt()
         try:
             return _save(
-                self, save_dir, tag=tag, client_state=client_state or {}
+                self, save_dir, tag=tag, client_state=client_state or {},
+                async_save=async_save, guard=self._ckpt_guard(),
             )
         finally:
             if self._nvme_swapper is not None:
@@ -2864,8 +2978,11 @@ class TpuEngine:
                 sp.end()
 
     def load_checkpoint(self, load_dir, tag=None, strict=True):
-        from .checkpointing import load_checkpoint as _load
+        from .ckpt import load_checkpoint as _load
 
+        guard = self._checkpoint_guard
+        if guard is not None:
+            guard.fence()  # never read a tag the writer is still landing
         if self._nvme_swapper is not None:
             self._swap_in_opt()  # loader needs a resident template tree
         out = _load(self, load_dir, tag=tag, strict=strict)
@@ -2876,6 +2993,12 @@ class TpuEngine:
     def destroy(self):
         """Parity: DeepSpeedEngine.destroy — release global hooks/writers so
         engines created in a loop don't accumulate loggers."""
+        if self._checkpoint_guard is not None:
+            # land the in-flight async save before the state it snapshotted
+            # is torn down (drain logs a writer failure instead of raising:
+            # teardown must complete)
+            self._checkpoint_guard.drain()
+            self._checkpoint_guard = None
         if self.healthwatch is not None:
             self.healthwatch.close()  # final exporter flush + unregister
             self.healthwatch = None

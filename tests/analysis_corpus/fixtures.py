@@ -56,6 +56,11 @@ trace-ready evidence of one statically-visible bug class:
   host-paging stream with a page too large for the staging window to
   hide on the host link (the clean twin is the shipped two-slot
   double-buffer over a real KiB-scale page)
+- ``restore_drops_sharding`` R2: a checkpoint-restore writeback that
+  rebuilds the optimizer carry from host arrays without re-putting to
+  the donated carry's resting shardings (the clean twin is
+  runtime/ckpt/reshard.py's explicit device_put to the destination
+  sharding)
 
 Each has a ``*_clean`` twin proving the rules don't fire on the fixed
 form. All fixtures trace on the 8-device CPU mesh (no execution).
@@ -1104,6 +1109,47 @@ def kv_spill_unbudgeted_clean():
     return closed, kw, "R8"
 
 
+# ------------------------------------------------------------- R2 (ckpt)
+def _restore_scan(mesh, drift: bool):
+    """runtime/ckpt restore discipline as a carry fixture: the optimizer
+    pair (m, v) rests dp-sharded on dim 0 and is rebuilt from host
+    rectangles at restore time. The hazard's writeback re-puts the
+    rebuilt tree WITHOUT the resting partition — what a loader that
+    skips reshard.py's final ``device_put(arr, sharding)`` compiles to —
+    so the donated carry re-enters the step loop de-sharded. The clean
+    twin re-puts to the resting sharding (reshard._resharded_leaf's
+    last line)."""
+    resting = NamedSharding(mesh, P("dp", None))
+    restored = NamedSharding(mesh, P(None, "tp") if drift else P("dp", None))
+
+    def step(m, v):
+        m = lax.with_sharding_constraint(m, resting)
+        v = lax.with_sharding_constraint(v, resting)
+
+        def body(carry, _):
+            cm, cv = carry
+            # the restore writeback: the carry rebuilt from host shards
+            cm = jax.device_put(cm * 0.9 + 0.1, restored)
+            cv = jax.device_put(cv * 0.99 + 0.01, restored)
+            return (cm, cv), ()
+
+        (m, v), _ = lax.scan(body, (m, v), None, length=4)
+        return m, v
+
+    sds = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    return jax.make_jaxpr(step)(sds, sds)
+
+
+def restore_drops_sharding():
+    mesh = corpus_mesh()
+    return _restore_scan(mesh, True), {"mesh": mesh}, "R2"
+
+
+def restore_drops_sharding_clean():
+    mesh = corpus_mesh()
+    return _restore_scan(mesh, False), {"mesh": mesh}, "R2"
+
+
 HAZARDS = [
     stacked_dim0_drift,
     slot_cache_carry_drift,
@@ -1130,6 +1176,7 @@ HAZARDS = [
     dcn_flat_ring,
     dcn_unbudgeted_stream,
     kv_spill_unbudgeted,
+    restore_drops_sharding,
 ]
 
 CLEAN_TWINS = [
@@ -1158,4 +1205,5 @@ CLEAN_TWINS = [
     dcn_flat_ring_clean,
     dcn_unbudgeted_stream_clean,
     kv_spill_unbudgeted_clean,
+    restore_drops_sharding_clean,
 ]

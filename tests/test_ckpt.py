@@ -1,0 +1,301 @@
+"""runtime/ckpt (ISSUE 20): async snapshot pipeline, committed-manifest
+atomicity, resharding-on-restore, preemption plumbing.
+
+The contract under test, per docs/checkpointing.md:
+
+- an ``async_save=True`` checkpoint is byte-identical to its sync twin
+  and the fence never perturbs the step (step_traces unchanged);
+- ``metadata.json`` is the commit record — a torn tag (shards present,
+  manifest missing) is refused LOUDLY on explicit load and is invisible
+  to latest-tag resolution;
+- restoring onto a different ParallelDims/MeshTopology/ZeRO stage
+  reassembles every leaf from overlapping source byte-ranges, and the
+  resumed loss trajectory is BITWISE identical to an uninterrupted run
+  (the cross-process version of the same oracle is ci.yml's
+  ``preemption`` job via tools/elastic_run.py);
+- SIGTERM commits a final sync save before the healthwatch postmortem
+  chain exits.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.comm.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.runtime.checkpointing import list_checkpoints
+from deepspeed_tpu.runtime.ckpt import (
+    CheckpointGuard,
+    UncommittedCheckpointError,
+    is_committed,
+    latest_committed_tag,
+    reset_preempt_handler,
+)
+
+
+def tiny_model():
+    return gpt2(
+        "gpt2-tiny", vocab_size=256, max_seq_len=16, hidden_size=32,
+        num_layers=1, num_heads=2,
+    )
+
+
+def flat(dp, ndev=None):
+    return MeshTopology(
+        dims=ParallelDims(dp=dp), devices=jax.devices()[: ndev or dp]
+    )
+
+
+def hybrid8():
+    """8-way dp with the dp axis riding DCN: same shard layout as flat
+    dp=8, different MeshTopology/link-kinds — the probe-verified
+    bitwise cross-mesh restore target."""
+    return MeshTopology.hybrid(ParallelDims(dp=8), dcn_axes=("dp",))
+
+
+def make_engine(zero_stage=3, topo=None, seed=0, ckpt=None, hw=False):
+    comm.destroy_process_group()
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero_stage},
+        "seed": seed,
+    }
+    if ckpt:
+        cfg["checkpoint"] = ckpt
+    if hw:
+        cfg["healthwatch"] = {"enabled": True}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=cfg, topology=topo or flat(8)
+    )
+    return engine
+
+
+def batch(seed=0):
+    r = np.random.RandomState(seed)
+    return {"input_ids": r.randint(0, 256, size=(8, 16))}
+
+
+def trees_equal(a, b):
+    oks = jax.tree_util.tree_leaves(
+        jax.tree.map(lambda x, y: bool(jnp.array_equal(x, y)), a, b)
+    )
+    return all(oks)
+
+
+# --------------------------------------------------------- async writer
+def test_async_save_exact_and_fence_is_invisible(tmp_path):
+    """The async snapshot must capture the state of the step it fenced
+    on — later training drift must not leak into the background write —
+    and neither the save nor the fence may retrace the step."""
+    engine = make_engine(ckpt={"async_save": True})
+    engine.train_batch(batch=batch(1))
+    engine.train_batch(batch=batch(2))
+    want_params = jax.device_get(engine.state.params)
+    want_opt = jax.device_get(engine.state.opt_state)
+    traces = engine.step_traces
+
+    engine.save_checkpoint(str(tmp_path))
+    engine.train_batch(batch=batch(3))  # drift while the writer runs
+    assert engine.step_traces == traces, "async save retraced the step"
+    engine.destroy()  # drains the writer
+
+    fresh = make_engine(seed=99)
+    assert not trees_equal(want_params, fresh.state.params)
+    fresh.load_checkpoint(str(tmp_path))
+    assert fresh.global_steps == 2
+    assert trees_equal(want_params, fresh.state.params)
+    assert trees_equal(want_opt, fresh.state.opt_state)
+    fresh.destroy()
+
+
+def test_guard_surfaces_writer_exception_on_fence():
+    """A failed background write must not be silent: the NEXT fence on
+    the main thread re-raises it (and the failed tag never committed)."""
+    guard = CheckpointGuard()
+
+    def boom():
+        raise OSError("disk full")
+
+    guard.launch(boom)
+    with pytest.raises(RuntimeError, match="did NOT commit"):
+        guard.fence()
+    guard.fence()  # the exception is consumed; the guard is reusable
+
+
+def test_torn_save_refused_loudly(tmp_path):
+    """Shards on disk without metadata.json = a torn save. Explicit-tag
+    load must raise; latest-tag resolution must not see it."""
+    engine = make_engine()
+    engine.train_batch(batch=batch(1))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    assert is_committed(str(tmp_path), "t1")
+    os.remove(os.path.join(str(tmp_path), "t1", "metadata.json"))
+    assert not is_committed(str(tmp_path), "t1")
+    assert latest_committed_tag(str(tmp_path)) is None
+
+    fresh = make_engine(seed=99)
+    with pytest.raises(UncommittedCheckpointError):
+        fresh.load_checkpoint(str(tmp_path), tag="t1")
+    # tag=None keeps the current state instead of loading torn bytes
+    path, client = fresh.load_checkpoint(str(tmp_path))
+    assert path is None and client == {}
+    fresh.destroy()
+    engine.destroy()
+
+
+def test_keep_last_prunes_committed_tags(tmp_path):
+    engine = make_engine(ckpt={"keep_last": 2})
+    for i in range(3):
+        engine.train_batch(batch=batch(i))
+        engine.save_checkpoint(str(tmp_path))
+    assert list_checkpoints(str(tmp_path)) == ["global_step2", "global_step3"]
+    assert latest_committed_tag(str(tmp_path)) == "global_step3"
+    engine.destroy()
+
+
+# ------------------------------------------------- resharding-on-restore
+@pytest.fixture(scope="module")
+def src_run(tmp_path_factory):
+    """One stage-3 dp=8 source run shared by the resharding tests:
+    train 2, save, then keep training — the SAME engine's continued
+    losses ARE the uninterrupted reference trajectory (a save mutates
+    nothing), so every restore leg below compares against it."""
+    d = str(tmp_path_factory.mktemp("src_ckpt"))
+    engine = make_engine(3)
+    for i in range(2):
+        engine.train_batch(batch=batch(100 + i))
+    engine.save_checkpoint(d)
+    params_at_save = jax.device_get(engine.state.params)
+    ref = [float(engine.train_batch(batch=batch(100 + i))) for i in (2, 3)]
+    engine.destroy()
+    return d, params_at_save, ref
+
+
+@pytest.mark.parametrize(
+    "dst_stage,dst_topo",
+    [
+        pytest.param(3, hybrid8, id="dp8flat-to-dcn-hybrid"),
+        pytest.param(1, lambda: flat(8), id="stage3-to-stage1"),
+    ],
+)
+def test_resume_bitwise_across_mesh_and_stage(src_run, dst_stage, dst_topo):
+    """Restore the stage-3 save onto a DIFFERENT topology/stage and
+    continue: the trajectory must match the uninterrupted run bitwise —
+    resharding is exact, not approximately-right."""
+    d, _, ref = src_run
+    dst = make_engine(dst_stage, topo=dst_topo(), seed=99)
+    dst.load_checkpoint(d)
+    got = [float(dst.train_batch(batch=batch(100 + i))) for i in (2, 3)]
+    dst.destroy()
+    assert got == ref, f"resumed trajectory diverged: {got} vs {ref}"
+
+
+def test_restore_onto_fsdp_hybrid_layout_exact(src_run):
+    """dp=8 flat -> dp=2(DCN)xfsdp=4(ICI): a genuinely different shard
+    layout (fsdp partitions params). The restored logical state must be
+    exact and the engine must still train."""
+    d, params_at_save, _ = src_run
+    dst = make_engine(
+        3, topo=MeshTopology.hybrid(ParallelDims(dp=2, fsdp=4)), seed=99
+    )
+    dst.load_checkpoint(d)
+    assert trees_equal(params_at_save, dst.state.params)
+    dst.train_batch(batch=batch(8))
+    dst.destroy()
+
+
+def test_restore_onto_fewer_devices_exact_state(tmp_path):
+    """dp=4 over 4 devices -> dp=2 over 2: each destination shard reads
+    two source shards' byte-ranges. The restored STATE is exact; the
+    continued trajectory is only ulp-close, not bitwise — shrinking the
+    world changes the loss all-reduce tree, so float summation order
+    legitimately differs. (The elastic oracle keeps the global device
+    count constant across rounds for exactly this reason.)"""
+    src = make_engine(2, topo=flat(4))
+    src.train_batch(batch=batch(200))
+    src.save_checkpoint(str(tmp_path))
+    save_params = jax.device_get(src.state.params)
+    ref = [float(src.train_batch(batch=batch(200 + i))) for i in (1, 2)]
+    src.destroy()
+
+    dst = make_engine(2, topo=flat(2), seed=99)
+    dst.load_checkpoint(str(tmp_path))
+    assert trees_equal(save_params, dst.state.params)
+    got = [float(dst.train_batch(batch=batch(200 + i))) for i in (1, 2)]
+    dst.destroy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+# --------------------------------------------- preemption + observability
+def test_sigterm_commits_final_save(tmp_path):
+    """The chained SIGTERM handler must commit a sync save before the
+    exit: resume lands on the exact preempted step."""
+    reset_preempt_handler()
+    old = signal.getsignal(signal.SIGTERM)
+    try:
+        engine = make_engine(
+            ckpt={"save_interval_steps": 100, "on_preempt": "save"}
+        )
+        engine.train_batch(batch=batch(1))
+        engine.save_checkpoint(str(tmp_path), tag="boot")  # installs hook
+        engine.train_batch(batch=batch(2))  # drift past the boot save
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler) and handler is not old
+        with pytest.raises(SystemExit) as e:
+            handler(signal.SIGTERM, None)
+        assert e.value.code == 128 + signal.SIGTERM
+        assert latest_committed_tag(str(tmp_path)) == "global_step2"
+        engine.destroy()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        reset_preempt_handler()
+
+
+def test_analytic_ckpt_snapshot_stream_amortized():
+    """save_interval_steps declares the cadence; the planner stream
+    prices snapshot bytes amortized over it and tags the checkpoint
+    goodput bucket so healthwatch won't double-count it as comm."""
+    engine = make_engine(
+        ckpt={"async_save": True, "save_interval_steps": 4}
+    )
+    stream = engine.analytic_streams()["ckpt_snapshot"]
+    assert stream["kind"] == "offload"
+    assert stream["overlapped"] is True
+    assert stream["goodput_bucket"] == "checkpoint"
+    assert stream["interval_steps"] == 4
+    assert stream["snapshot_bytes"] > 0
+    assert stream["bytes_per_step"] == pytest.approx(
+        stream["snapshot_bytes"] / 4
+    )
+    engine.destroy()
+
+    off = make_engine()
+    assert "ckpt_snapshot" not in off.analytic_streams()
+    off.destroy()
+
+
+def test_goodput_charges_fence_and_reports_writer_seconds(tmp_path):
+    """The checkpoint goodput bucket charges only the in-step fence;
+    the background writer's seconds surface separately as ckpt_write_s
+    (and the checkpoint_stall rule is armed by default)."""
+    from deepspeed_tpu.profiling.healthwatch import DEFAULT_RULES
+
+    assert "checkpoint_stall" in DEFAULT_RULES
+    engine = make_engine(
+        ckpt={"async_save": True, "save_interval_steps": 2}, hw=True
+    )
+    engine.train_batch(batch=batch(1))
+    engine.save_checkpoint(str(tmp_path))
+    engine.train_batch(batch=batch(2))
+    engine._ckpt_guard().fence()
+    g = engine.healthwatch.goodput()
+    assert g["ckpt_write_s"] > 0.0
+    assert "checkpoint" in g["buckets"]
+    engine.destroy()
